@@ -1,0 +1,71 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A ground-up re-design of Horovod 0.15.1 (the shyhuai fork, with sparse/top-k
+allreduce) for TPU: the data plane is XLA collectives over the ICI/DCN mesh
+(``psum`` / ``all_gather`` / collective-permute emitted from ``shard_map`` /
+``pjit``), the eager frontend is an async-handle engine with Horovod's
+fusion/cycle/stall-check/timeline semantics, and the optimizer wrappers are
+optax/flax-native (plus a torch frontend for API parity).
+
+Two ways to use it, mirroring the reference's two frontends:
+
+* **Compiled SPMD** (the TF-graph analogue, and the fast path): call
+  ``horovod_tpu.ops.allreduce(...)`` — or just use ``DistributedOptimizer``
+  — inside your jitted step function over the ``"hvd"`` mesh axis.
+* **Eager** (the PyTorch analogue): ``hvd.allreduce / allgather / broadcast``
+  on rank-major arrays, with ``*_async`` + ``poll`` / ``synchronize``
+  handles, background fusion cycles, and the Chrome-trace timeline.
+
+Quick start (the reference's canonical recipe, examples/pytorch_mnist.py)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    x = hvd.per_rank(lambda r: grad_shard_for(r))   # rank-major tensor
+    g = hvd.allreduce(x, average=True)              # fused psum over ICI
+"""
+
+from horovod_tpu.basics import (  # noqa: F401
+    AXIS_NAME,
+    CPU_DEVICE_ID,
+    NotInitializedError,
+    axis_rank,
+    cross_rank,
+    cross_size,
+    from_per_rank,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_threads_supported,
+    per_rank,
+    rank,
+    rank_sharding,
+    replicated_sharding,
+    shutdown,
+    size,
+)
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.eager import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    grouped_allreduce_eager,
+    poll,
+    sparse_allreduce,
+    sparse_allreduce_async,
+    synchronize,
+)
+from horovod_tpu import ops  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,284 @@
+"""Process / device model: ``init``, ``rank``, ``size``, mesh management.
+
+TPU-native re-design of the reference's process model
+(reference: horovod/common/__init__.py:51-154 ``HorovodBasics`` and the C API
+horovod/common/operations.cc:2040-2095).
+
+The reference runs ONE process per GPU under ``mpirun``; ``rank()`` names the
+process and ``local_rank()`` pins its GPU.  On TPU the idiomatic model is
+single-controller-per-host JAX: one Python process drives ``local_device_count``
+chips and multi-host jobs use ``jax.distributed``.  The mapping is:
+
+==================  ==========================================================
+Horovod concept      TPU-native equivalent
+==================  ==========================================================
+world (all ranks)    all devices of the global ``Mesh`` (axis ``"hvd"``)
+``size()``           global device count (chips == Horovod ranks)
+``local_size()``     ``jax.local_device_count()``
+``rank()``           global index of this process's first device
+``local_rank()``     always 0 for the controller process (device pinning is
+                     handled by the runtime, not the user)
+``cross_size()``     ``jax.process_count()``   (number of hosts)
+``cross_rank()``     ``jax.process_index()``   (this host's index)
+==================  ==========================================================
+
+Inside compiled SPMD code (``shard_map`` over the mesh) the *per-chip* rank is
+``jax.lax.axis_index("hvd")`` — exposed here as :func:`axis_rank`.
+
+Eager collectives (see :mod:`horovod_tpu.ops.eager`) operate on **rank-major**
+arrays: a logical "tensor held by every rank" is represented as one
+``jax.Array`` of shape ``[size(), *shape]`` sharded along axis 0, so each chip
+holds its own slice — the single-controller analogue of per-process tensors.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.utils.env import EngineConfig
+
+AXIS_NAME = "hvd"
+
+# Analogue of CPU_DEVICE_ID (reference horovod/common/common.h:100): kept for
+# API parity where a device id is reported for host-resident tensors.
+CPU_DEVICE_ID = -1
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when the API is used before ``init()``.
+
+    Parity with the reference's "Horovod has not been initialized; use
+    hvd.init()." ctypes-level errors (horovod/common/operations.cc:2047-2095).
+    """
+
+
+class _State:
+    """Global framework state — the analogue of ``HorovodGlobalState``
+    (reference horovod/common/operations.cc:112-264), minus everything XLA
+    already owns (streams, communicators, fusion buffers on device)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.shut_down = False
+        self.mesh: Mesh | None = None
+        self.config: EngineConfig = EngineConfig()
+        self.engine = None  # lazily created EagerEngine
+        self.timeline = None  # lazily created Timeline
+
+
+_state = _State()
+
+
+_distributed_initialized = False
+
+
+def _maybe_init_distributed() -> None:
+    """Initialize multi-host JAX when a coordinator is configured.
+
+    The reference calls ``MPI_Init_thread`` on its background thread
+    (horovod/common/operations.cc:1505-1525); the TPU equivalent is
+    ``jax.distributed.initialize()``, driven by env config rather than MPI.
+
+    Must run before any other JAX call initializes the XLA backend, so the
+    guard is a module flag — probing ``jax.process_count()`` here would
+    itself initialize the backend and poison ``initialize()``.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    addr = os.environ.get("HOROVOD_TPU_COORDINATOR") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    nproc = os.environ.get("HOROVOD_TPU_NUM_PROCESSES")
+    pid = os.environ.get("HOROVOD_TPU_PROCESS_ID")
+    if addr and nproc and pid:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(nproc),
+                process_id=int(pid),
+            )
+        except RuntimeError as e:
+            raise RuntimeError(
+                "horovod_tpu.init() could not start multi-host JAX: "
+                f"{e}.  Call hvd.init() before any other JAX API so the "
+                "distributed runtime can be set up first."
+            ) from e
+        _distributed_initialized = True
+
+
+def init(
+    devices: Sequence[jax.Device] | None = None,
+    mesh: Mesh | None = None,
+) -> None:
+    """Initialize the framework.  Analogue of ``hvd.init()``
+    (reference horovod/common/__init__.py:58-84 → operations.cc:2011-2029).
+
+    Args:
+      devices: optional subset of devices to form the world (the analogue of
+        the reference's ``init(comm=[ranks])`` rank-subset form).  Defaults to
+        all devices.
+      mesh: optional pre-built 1-D mesh whose single axis becomes the Horovod
+        world.  Overrides ``devices``.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        _maybe_init_distributed()
+        if mesh is not None:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "init(mesh=...) expects a 1-D mesh; for multi-axis "
+                    "parallelism build your own mesh and use "
+                    "horovod_tpu.ops in-graph collectives directly."
+                )
+            _state.mesh = Mesh(mesh.devices, (AXIS_NAME,))
+        else:
+            devs = list(devices) if devices is not None else jax.devices()
+            import numpy as np
+
+            _state.mesh = Mesh(np.asarray(devs), (AXIS_NAME,))
+        _state.config = EngineConfig.from_env()
+        _state.initialized = True
+        _state.shut_down = False
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    """Shut the framework down.  Analogue of ``hvd.shutdown()``
+    (reference horovod/common/__init__.py atexit hook → operations.cc:2046).
+
+    Drains the eager engine (all outstanding handles complete or error) and
+    releases global state; idempotent.
+    """
+    with _state.lock:
+        if not _state.initialized or _state.shut_down:
+            return
+        engine, _state.engine = _state.engine, None
+        timeline, _state.timeline = _state.timeline, None
+        _state.shut_down = True
+        _state.initialized = False
+        _state.mesh = None
+    if engine is not None:
+        engine.shutdown()
+    if timeline is not None:
+        timeline.close()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _State:
+    if not _state.initialized:
+        raise NotInitializedError(
+            "horovod_tpu has not been initialized; use horovod_tpu.init()."
+        )
+    return _state
+
+
+def mesh() -> Mesh:
+    """The world mesh (single axis ``"hvd"``, one entry per chip)."""
+    return _require_init().mesh
+
+
+def config() -> EngineConfig:
+    return _require_init().config
+
+
+def size() -> int:
+    """Total number of chips in the world — the Horovod world size
+    (reference operations.cc:2063-2067)."""
+    return _require_init().mesh.devices.size
+
+
+def local_size() -> int:
+    """Chips driven by this host (reference operations.cc:2069-2073)."""
+    st = _require_init()
+    local = [d for d in st.mesh.devices.flat if d.process_index == jax.process_index()]
+    return len(local)
+
+
+def rank() -> int:
+    """Global index of this process's first device
+    (reference operations.cc:2051-2055; see module docstring for mapping)."""
+    st = _require_init()
+    for i, d in enumerate(st.mesh.devices.flat):
+        if d.process_index == jax.process_index():
+            return i
+    return 0
+
+
+def local_rank() -> int:
+    """Always 0 on the controller process (reference operations.cc:2057-2061;
+    device pinning is owned by the TPU runtime, not user code)."""
+    _require_init()
+    return 0
+
+
+def cross_size() -> int:
+    """Number of hosts (the reference's cross-communicator size,
+    operations.cc:1558-1590)."""
+    _require_init()
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    """This host's index (reference cross-communicator rank)."""
+    _require_init()
+    return jax.process_index()
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim (reference operations.cc:2089-2095).  There is no MPI in
+    the TPU runtime; multi-controller coordination is always thread-safe."""
+    _require_init()
+    return True
+
+
+def axis_rank():
+    """Per-chip rank inside compiled SPMD code: ``lax.axis_index("hvd")``."""
+    return jax.lax.axis_index(AXIS_NAME)
+
+
+# ---------------------------------------------------------------------------
+# Rank-major helpers: build / inspect the eager representation.
+# ---------------------------------------------------------------------------
+
+
+def rank_sharding() -> NamedSharding:
+    """Sharding that splits axis 0 over ranks (eager rank-major layout)."""
+    return NamedSharding(mesh(), P(AXIS_NAME))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(mesh(), P())
+
+
+def from_per_rank(values) -> jax.Array:
+    """Stack one-per-rank host values into a rank-major sharded array.
+
+    The single-controller analogue of "each MPI process holds its tensor":
+    ``values`` is a sequence of ``size()`` equal-shaped arrays; the result has
+    shape ``[size(), *shape]`` with shard *i* resident on chip *i*.
+    """
+    import jax.numpy as jnp
+
+    n = size()
+    if len(values) != n:
+        raise ValueError(f"expected {n} per-rank values, got {len(values)}")
+    stacked = jnp.stack([jnp.asarray(v) for v in values])
+    return jax.device_put(stacked, rank_sharding())
+
+
+def per_rank(fn) -> jax.Array:
+    """Build a rank-major array from ``fn(rank) -> array``  (test helper for
+    the reference's rank-dependent tensors, test/test_tensorflow.py:56-86)."""
+    return from_per_rank([fn(r) for r in range(size())])

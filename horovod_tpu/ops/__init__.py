@@ -1,0 +1,16 @@
+"""Collective ops: in-graph (SPMD) and eager (rank-major) flavors."""
+
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    grouped_allreduce,
+    reducescatter,
+)

@@ -1,0 +1,142 @@
+"""Gradient compression algorithms.
+
+Parity with the reference's ``Compression`` registry
+(reference: horovod/tensorflow/compression.py:1-74 and
+horovod/torch/compression.py:1-74), extended with the fork's top-k sparse
+scheme (reference: horovod/torch/__init__.py:46-83, 141-151, 202-216) as a
+first-class compressor.
+
+TPU notes: the natural 16-bit wire type on TPU is **bfloat16** (same exponent
+range as fp32, MXU-native).  ``Compression.fp16`` keeps the reference's name
+and uses float16 for bit-parity; ``Compression.bf16`` is the TPU-preferred
+variant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Compressor:
+    """Interface: compress before the wire transfer, decompress after.
+
+    Mirrors reference compression.py:23-44.
+    """
+
+    @staticmethod
+    def compress(tensor: jax.Array) -> tuple[jax.Array, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: jax.Array, ctx: Any) -> jax.Array:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference compression.py:47-57)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        del ctx
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: Any = None
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and tensor.dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast-down to float16 for the transfer, cast back after
+    (reference compression.py:60-74)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native 16-bit wire format: bfloat16 keeps fp32's exponent range so
+    gradient all-reduce needs no loss-scaling."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class TopKContext(NamedTuple):
+    shape: tuple
+    dtype: Any
+    k: int
+
+
+class TopKCompressor:
+    """Top-k sparse gradients — the fork's headline feature, TPU-style.
+
+    The fork compresses by picking the k largest-magnitude entries and
+    allgathering ``(values ‖ indices)`` with mpi4py, then scatter-adding on
+    every rank (reference horovod/torch/__init__.py:46-83).  The TPU-native
+    form does the same dataflow inside one compiled program:
+    ``lax.top_k`` on |flat gradient| → ``all_gather(values, indices)`` →
+    ``scatter-add`` into a dense buffer, all fused by XLA.
+
+    Unlike the dense compressors this changes the *collective* (allgather
+    instead of allreduce), so it exposes :meth:`sparse_allreduce` and the
+    ``Compressor`` interface raises if used on the dense path.
+    """
+
+    def __init__(self, ratio: float = 0.01, k: int | None = None):
+        self.ratio = ratio
+        self.k = k
+
+    def _k_for(self, n: int) -> int:
+        if self.k is not None:
+            return max(1, min(self.k, n))
+        return max(1, min(n, int(n * self.ratio)))
+
+    def compress(self, tensor):
+        raise NotImplementedError(
+            "TopKCompressor changes the collective; use sparse_allreduce()."
+        )
+
+    decompress = compress
+
+    def sparse_allreduce(self, tensor: jax.Array, *, average: bool = False,
+                         axis_name: str = "hvd") -> jax.Array:
+        flat = tensor.reshape(-1)
+        n = flat.shape[0]
+        k = self._k_for(n)
+        vals, idxs = lax.top_k(jnp.abs(flat), k)
+        del vals
+        picked = flat[idxs]
+        all_vals = lax.all_gather(picked, axis_name, tiled=True)     # [size*k]
+        all_idxs = lax.all_gather(idxs, axis_name, tiled=True)       # [size*k]
+        dense = jnp.zeros_like(flat).at[all_idxs].add(all_vals)
+        if average:
+            dense = dense / lax.axis_size(axis_name)
+        return dense.reshape(tensor.shape)
+
+
+class Compression:
+    """Registry, parity with reference compression.py:70-74."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor
+    topk = TopKCompressor

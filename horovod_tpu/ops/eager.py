@@ -1,0 +1,573 @@
+"""The eager engine: async named-tensor collectives with fusion cycles.
+
+TPU-native re-design of the reference's background coordination engine
+(reference: horovod/common/operations.cc — ``BackgroundThreadLoop``
+:1493-1764, ``RunLoopOnce`` :1795-2007, ``PerformOperation`` :734-1420).
+
+What the reference engine does, and where it went on TPU:
+
+* **Negotiation** (rank-0 gathers requests, matches readiness): exists
+  because each MPI process schedules ops in nondeterministic order.  Under a
+  single JAX controller, one Python thread observes *every* enqueue, so
+  readiness matching is a queue.  In multi-controller jobs the user program
+  is identical on every host, so op *order* agrees, but flush *timing* does
+  not — therefore fusion grouping there is restricted to caller-delimited
+  groups (see ``_fuse_key``), which are identical across hosts by
+  construction.  The queue-until-cycle behaviour (and its observability via
+  the Timeline NEGOTIATE phase) is retained.
+* **Tensor fusion** (memcpy into a 64 MiB buffer, one collective): becomes
+  same-dtype bucketing into ONE concatenated psum per bucket, compiled by
+  XLA (see :mod:`horovod_tpu.ops.fusion`); ``HOROVOD_FUSION_THRESHOLD`` and
+  ``HOROVOD_CYCLE_TIME`` keep their meaning.
+* **Execution** (NCCL/MPI calls on a private stream): becomes dispatch of a
+  cached jitted ``shard_map`` program; XLA owns streams, buffers and the ICI
+  wire.  Async handles map onto JAX's async dispatch — a dispatched op IS a
+  future.
+* **Stall check** (operations.cc:1424-1470): a watchdog thread warns about
+  tensors enqueued but never synchronized.
+
+Eager tensors use the **rank-major** representation (see
+:mod:`horovod_tpu.basics`): a logical per-rank tensor of shape ``S`` is one
+``jax.Array`` of shape ``[size, *S]`` sharded over axis 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import sys
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu import basics, timeline as timeline_mod
+from horovod_tpu.basics import AXIS_NAME
+from horovod_tpu.ops import collective_ops
+from horovod_tpu.ops.collective_ops import Average, Sum, _ReduceOp
+from horovod_tpu.ops.compression import Compression, TopKCompressor
+from horovod_tpu.ops.handle_manager import HandleManager
+
+
+@dataclasses.dataclass
+class _PendingOp:
+    kind: str                      # 'allreduce' | 'allgather' | 'broadcast' | 'sparse'
+    handle: int
+    tensor: jax.Array              # rank-major stacked input
+    name: str
+    op: _ReduceOp = Sum
+    compression: Any = Compression.none
+    root_rank: int = 0
+    sizes: tuple[int, ...] | None = None   # ragged allgather per-rank dim-0 sizes
+    topk: TopKCompressor | None = None
+    group_id: int | None = None            # caller-delimited fusion group
+    enqueued_at: float = 0.0
+
+
+def _per_rank_nbytes(stacked: jax.Array) -> int:
+    n = stacked.shape[0]
+    return (int(stacked.size) // max(n, 1)) * stacked.dtype.itemsize
+
+
+class EagerEngine:
+    """Background engine: queue → cycle tick → fuse → dispatch.
+
+    One instance per :func:`horovod_tpu.init`; created lazily on first eager
+    op (the reference spawns its thread inside ``InitializeHorovodOnce``,
+    operations.cc:2011-2029).
+    """
+
+    def __init__(self, mesh, cfg, timeline=None):
+        self.mesh = mesh
+        self.config = cfg
+        self.handles = HandleManager()
+        self.timeline = timeline
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._queue: list[_PendingOp] = []
+        self._dispatch_cache: dict[tuple, Any] = {}
+        self._shutdown = threading.Event()
+        self._tick = threading.Event()
+        self._cycle_thread = threading.Thread(
+            target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
+        )
+        self._cycle_thread.start()
+        self._stall_thread: threading.Thread | None = None
+        if cfg.stall_check_enabled:
+            self._stall_thread = threading.Thread(
+                target=self._stall_loop, name="horovod_tpu-stall-check", daemon=True
+            )
+            self._stall_thread.start()
+
+    # ------------------------------------------------------------------ queue
+
+    def enqueue(self, pending: _PendingOp) -> int:
+        """Analogue of EnqueueTensorAllreduce/Allgather/Broadcast
+        (reference operations.cc:2099-2215): push into the shared queue under
+        the table mutex; the cycle thread picks it up."""
+        pending.enqueued_at = time.monotonic()
+        if self.timeline:
+            self.timeline.start(pending.name, timeline_mod.NEGOTIATE + "_" + pending.kind.upper())
+        with self._lock:
+            if self._shutdown.is_set():
+                raise RuntimeError("horovod_tpu engine has been shut down")
+            self._queue.append(pending)
+        return pending.handle
+
+    def _fuse_key(self, p: _PendingOp):
+        """Fusability key for :func:`fusion.plan_buckets` — the eager
+        analogue of the reference's same-type/same-device merge predicate
+        (operations.cc:1916-1943).
+
+        In multi-controller jobs, fusion decided by host-local flush timing
+        would let different hosts dispatch differently-fused collectives and
+        deadlock; there, only *caller-delimited* groups (grouped_allreduce's
+        ``group_id``, identical across hosts because the user program is)
+        may fuse.  Single-controller keeps timing-based fusion — one thread
+        observes every enqueue, so any grouping is consistent.
+        """
+        if p.kind != "allreduce":
+            return ("solo", p.handle)
+        base = ("ar", p.op.name, p.compression, str(p.tensor.dtype))
+        if jax.process_count() > 1:
+            return base + (
+                ("grp", p.group_id) if p.group_id is not None else ("solo", p.handle),
+            )
+        return base
+
+    def flush(self) -> None:
+        """Drain the queue now: group, fuse, dispatch.
+
+        The analogue of one ``RunLoopOnce`` tick (operations.cc:1795-2007)
+        minus the MPI negotiation (see module docstring).  Serialized under
+        ``_flush_lock`` so concurrent callers (cycle thread, poll,
+        synchronize) cannot interleave dispatch order."""
+        from horovod_tpu.ops import fusion
+
+        with self._flush_lock:
+            with self._lock:
+                batch, self._queue = self._queue, []
+            if not batch:
+                return
+            for p in batch:
+                if self.timeline:
+                    self.timeline.end(
+                        p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
+                    )
+            buckets = fusion.plan_buckets(
+                batch,
+                self.config.fusion_threshold_bytes,
+                nbytes=lambda p: _per_rank_nbytes(p.tensor),
+                key=self._fuse_key,
+            )
+            for bucket in buckets:
+                group = [batch[i] for i in bucket]
+                if group[0].kind == "allreduce":
+                    self._dispatch_allreduce_group(group)
+                else:
+                    assert len(group) == 1
+                    self._dispatch_single(group[0])
+
+    def _cycle_loop(self) -> None:
+        """Background tick every ``HOROVOD_CYCLE_TIME`` ms
+        (reference operations.cc:1795 tick + :1661-1685 knob)."""
+        period = max(self.config.cycle_time_ms, 0.1) / 1000.0
+        while not self._shutdown.is_set():
+            self._tick.wait(timeout=period)
+            self._tick.clear()
+            try:
+                self.flush()
+            except Exception:  # pragma: no cover - defensive: keep ticking
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+
+    def _stall_loop(self) -> None:
+        """Warn about tensors stuck in the queue — parity with
+        CheckForStalledTensors (reference operations.cc:1424-1470)."""
+        warn_after = self.config.stall_warning_time_s
+        while not self._shutdown.is_set():
+            self._shutdown.wait(timeout=min(warn_after / 4.0, 15.0))
+            if self._shutdown.is_set():
+                return
+            now = time.monotonic()
+            with self._lock:
+                stalled = [
+                    p.name for p in self._queue if now - p.enqueued_at > warn_after
+                ]
+            if stalled:
+                print(
+                    "WARNING: One or more tensors were submitted to be "
+                    "reduced, gathered or broadcasted by subset of ranks and "
+                    f"are waiting for remainder of ranks for more than {int(warn_after)} "
+                    "seconds. Stalled ops: " + ", ".join(sorted(stalled)),
+                    file=sys.stderr,
+                )
+
+    def shutdown(self) -> None:
+        """Coordinated shutdown: flush outstanding work, stop threads
+        (reference operations.cc:1699-1729)."""
+        try:
+            self.flush()
+        finally:
+            self._shutdown.set()
+            self._tick.set()
+            if self._cycle_thread.is_alive():
+                self._cycle_thread.join(timeout=5)
+            if self._stall_thread is not None and self._stall_thread.is_alive():
+                self._stall_thread.join(timeout=5)
+
+    # --------------------------------------------------------------- dispatch
+
+    def _shard_map(self, fn, out_specs=P()):
+        from jax import shard_map
+
+        # check_vma=False: outputs of these dispatch programs are replicated
+        # by construction (psum / all_gather semantics), which the varying-
+        # manual-axes inference cannot always prove.
+        return jax.jit(
+            shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=P(AXIS_NAME),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    def _allreduce_group_fn(self, op: _ReduceOp, compression) -> Any:
+        """One jitted program: concat per-rank flats → ONE collective →
+        split.  This is the Horovod fusion buffer, compiled
+        (reference operations.cc:999-1053 memcpys become XLA layout ops)."""
+        key = ("ar", op.name, compression)
+        fn = self._dispatch_cache.get(key)
+        if fn is None:
+
+            def fused(xs):
+                flats = [x.reshape(-1) for x in xs]
+                buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+                red = collective_ops.allreduce(
+                    buf, op=op, axis_name=AXIS_NAME, compression=compression
+                )
+                outs, off = [], 0
+                for x in xs:
+                    n = int(x.size)
+                    outs.append(lax.slice(red, (off,), (off + n,)))
+                    off += n
+                return tuple(outs)
+
+            fn = self._shard_map(fused)
+            self._dispatch_cache[key] = fn
+        return fn
+
+    def _dispatch_allreduce_group(self, group: list[_PendingOp]) -> None:
+        names = [p.name for p in group]
+        if self.timeline:
+            for n in names:
+                self.timeline.start(n, "ALLREDUCE", {"fused_with": len(group) - 1})
+                self.timeline.start(n, timeline_mod.DISPATCH)
+        try:
+            fn = self._allreduce_group_fn(group[0].op, group[0].compression)
+            outs = fn(tuple(p.tensor.reshape(p.tensor.shape[0], -1) for p in group))
+            for p, out in zip(group, outs):
+                self.handles.mark_dispatched(
+                    p.handle, out.reshape(p.tensor.shape[1:])
+                )
+        except Exception as e:
+            for p in group:
+                self.handles.mark_error(p.handle, e)
+        finally:
+            if self.timeline:
+                for n in names:
+                    self.timeline.end(n, timeline_mod.DISPATCH)
+                    self.timeline.end(n, "ALLREDUCE")
+
+    def _dispatch_single(self, p: _PendingOp) -> None:
+        if self.timeline:
+            self.timeline.start(p.name, p.kind.upper())
+        try:
+            if p.kind == "broadcast":
+                key = ("bc", int(p.root_rank))
+                fn = self._dispatch_cache.get(key)
+                if fn is None:
+                    root = int(p.root_rank)
+
+                    def bc(x):
+                        return collective_ops.broadcast(
+                            x[0], root, axis_name=AXIS_NAME
+                        )
+
+                    fn = self._shard_map(bc)
+                    self._dispatch_cache[key] = fn
+                self.handles.mark_dispatched(p.handle, fn(p.tensor))
+            elif p.kind == "allgather":
+                fn = self._dispatch_cache.get("ag")
+                if fn is None:
+
+                    def ag(x):
+                        return lax.all_gather(x[0], AXIS_NAME, tiled=True)
+
+                    fn = self._shard_map(ag)
+                    self._dispatch_cache["ag"] = fn
+                gathered = fn(p.tensor)  # [size * padded_d0, rest]
+                if p.sizes is not None:
+                    pad = p.tensor.shape[1]
+                    pieces = []
+                    for r, s in enumerate(p.sizes):
+                        pieces.append(
+                            lax.slice_in_dim(gathered, r * pad, r * pad + s, axis=0)
+                        )
+                    gathered = jnp.concatenate(pieces, axis=0)
+                self.handles.mark_dispatched(p.handle, gathered)
+            elif p.kind == "sparse":
+                topk = p.topk
+                key = ("sp", topk.ratio, topk.k, p.op.name)
+                fn = self._dispatch_cache.get(key)
+                if fn is None:
+                    avg = p.op is Average
+
+                    def sp(x):
+                        return topk.sparse_allreduce(
+                            x[0], average=avg, axis_name=AXIS_NAME
+                        )
+
+                    fn = self._shard_map(sp)
+                    self._dispatch_cache[key] = fn
+                self.handles.mark_dispatched(p.handle, fn(p.tensor))
+            else:  # pragma: no cover
+                raise ValueError(f"unknown op kind {p.kind}")
+        except Exception as e:
+            self.handles.mark_error(p.handle, e)
+        finally:
+            if self.timeline:
+                self.timeline.end(p.name, p.kind.upper())
+
+
+# ---------------------------------------------------------------------------
+# Module-level eager API (the reference's horovod/torch/mpi_ops.py surface).
+# ---------------------------------------------------------------------------
+
+_group_counter = itertools.count()
+_name_counter = threading.Lock()
+_name_seq = 0
+
+
+def _auto_name(prefix: str) -> str:
+    global _name_seq
+    with _name_counter:
+        _name_seq += 1
+        return f"{prefix}.noname.{_name_seq}"
+
+
+def _engine() -> EagerEngine:
+    st = basics._require_init()
+    with st.lock:
+        if st.engine is None:
+            st.timeline = timeline_mod.maybe_create(st.config.timeline_file)
+            st.engine = EagerEngine(st.mesh, st.config, st.timeline)
+        return st.engine
+
+
+def _as_rank_major(tensor, kind: str) -> jax.Array:
+    t = jnp.asarray(tensor)
+    n = basics.size()
+    if t.ndim == 0 or t.shape[0] != n:
+        raise ValueError(
+            f"eager {kind} expects a rank-major array of shape [size={n}, ...]; "
+            f"got shape {t.shape}.  Build one with horovod_tpu.from_per_rank / "
+            "per_rank, or use a replicated value with hvd.broadcast semantics."
+        )
+    if not isinstance(t, jax.Array) or t.sharding != basics.rank_sharding():
+        t = jax.device_put(t, basics.rank_sharding())
+    return t
+
+
+def allreduce_async(
+    tensor,
+    average: bool | None = None,
+    name: str | None = None,
+    *,
+    op: _ReduceOp = Sum,
+    compression=Compression.none,
+    group_id: int | None = None,
+) -> int:
+    """Async all-reduce of a rank-major tensor; returns a handle
+    (reference horovod/torch/mpi_ops.py:156-176)."""
+    if average is not None:
+        op = Average if average else Sum
+    eng = _engine()
+    t = _as_rank_major(tensor, "allreduce")
+    h = eng.handles.allocate()
+    eng.enqueue(
+        _PendingOp(
+            kind="allreduce",
+            handle=h,
+            tensor=t,
+            name=name or _auto_name("allreduce"),
+            op=op,
+            compression=compression,
+            group_id=group_id,
+        )
+    )
+    return h
+
+
+def allreduce(tensor, average: bool | None = None, name: str | None = None,
+              *, op: _ReduceOp = Sum, compression=Compression.none):
+    """Blocking all-reduce (reference horovod/torch/mpi_ops.py:60-109).
+    Returns the reduced tensor, fully replicated over the mesh."""
+    return synchronize(
+        allreduce_async(tensor, average, name, op=op, compression=compression)
+    )
+
+
+def sparse_allreduce_async(
+    tensor, name: str | None = None, *, average: bool = False,
+    ratio: float = 0.01, k: int | None = None,
+) -> int:
+    """Fork-parity top-k sparse allreduce (reference
+    horovod/torch/__init__.py:46-83), compiled: top_k → all_gather →
+    scatter-add in one program."""
+    eng = _engine()
+    t = _as_rank_major(tensor, "sparse_allreduce")
+    h = eng.handles.allocate()
+    eng.enqueue(
+        _PendingOp(
+            kind="sparse",
+            handle=h,
+            tensor=t,
+            name=name or _auto_name("sparse_allreduce"),
+            op=Average if average else Sum,
+            topk=TopKCompressor(ratio=ratio, k=k),
+        )
+    )
+    return h
+
+
+def sparse_allreduce(tensor, name: str | None = None, *, average: bool = False,
+                     ratio: float = 0.01, k: int | None = None):
+    return synchronize(
+        sparse_allreduce_async(tensor, name, average=average, ratio=ratio, k=k)
+    )
+
+
+def allgather_async(tensors, name: str | None = None) -> int:
+    """Async allgather; ``tensors`` is rank-major or a list of per-rank
+    tensors whose first dims may differ (reference allgather-with-unequal-
+    first-dims, operations.cc:841-901 — size negotiation happens host-side
+    here since the controller sees every rank's shape)."""
+    eng = _engine()
+    sizes = None
+    if isinstance(tensors, (list, tuple)):
+        n = basics.size()
+        if len(tensors) != n:
+            raise ValueError(f"expected {n} per-rank tensors, got {len(tensors)}")
+        ts = [jnp.asarray(t) for t in tensors]
+        rests = {t.shape[1:] for t in ts}
+        if len(rests) > 1:
+            raise ValueError(
+                "allgather: per-rank tensors must agree on all dims except "
+                f"dim 0; got trailing shapes {sorted(map(str, rests))}"
+            )
+        dtypes = {t.dtype for t in ts}
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"allgather: per-rank tensors must share a dtype; got {dtypes}"
+            )
+        sizes = tuple(int(t.shape[0]) for t in ts)
+        pad = max(sizes)
+        padded = [
+            jnp.pad(t, [(0, pad - t.shape[0])] + [(0, 0)] * (t.ndim - 1))
+            for t in ts
+        ]
+        t = jax.device_put(jnp.stack(padded), basics.rank_sharding())
+        if len(set(sizes)) == 1:
+            sizes = None
+    else:
+        t = _as_rank_major(tensors, "allgather")
+    h = eng.handles.allocate()
+    eng.enqueue(
+        _PendingOp(
+            kind="allgather",
+            handle=h,
+            tensor=t,
+            name=name or _auto_name("allgather"),
+            sizes=sizes,
+        )
+    )
+    return h
+
+
+def allgather(tensors, name: str | None = None):
+    return synchronize(allgather_async(tensors, name))
+
+
+def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+    """Async broadcast of rank ``root_rank``'s slice to all
+    (reference horovod/torch/mpi_ops.py:318-405)."""
+    eng = _engine()
+    t = _as_rank_major(tensor, "broadcast")
+    if not 0 <= root_rank < basics.size():
+        raise ValueError(f"root_rank {root_rank} outside [0, {basics.size()})")
+    h = eng.handles.allocate()
+    eng.enqueue(
+        _PendingOp(
+            kind="broadcast",
+            handle=h,
+            tensor=t,
+            name=name or _auto_name("broadcast"),
+            root_rank=root_rank,
+        )
+    )
+    return h
+
+
+def broadcast(tensor, root_rank: int, name: str | None = None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def poll(handle: int) -> bool:
+    """Non-blocking completion probe (reference torch/mpi_ops.py:406-419)."""
+    eng = _engine()
+    eng.flush()
+    return eng.handles.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the op completes; returns its output
+    (reference torch/mpi_ops.py:422-438)."""
+    eng = _engine()
+    return eng.handles.wait(handle, eng.flush)
+
+
+def grouped_allreduce_eager(
+    tensors: Sequence, average: bool | None = None, names: list[str] | None = None,
+    *, op: _ReduceOp = Sum, compression=Compression.none,
+) -> list:
+    """Enqueue many allreduces in one call; the engine fuses them into
+    buckets (the reference achieves this implicitly when many grads arrive in
+    one cycle — test/test_torch.py:175-224 ``..._async_fused``).
+
+    The call delimits a fusion group, so fusion stays deterministic across
+    hosts in multi-controller jobs (see ``EagerEngine._fuse_key``)."""
+    if names is not None and len(names) != len(tensors):
+        raise ValueError(
+            f"names has {len(names)} entries for {len(tensors)} tensors"
+        )
+    gid = next(_group_counter)
+    handles = [
+        allreduce_async(
+            t,
+            average,
+            (names[i] if names else None),
+            op=op,
+            compression=compression,
+            group_id=gid,
+        )
+        for i, t in enumerate(tensors)
+    ]
+    return [synchronize(h) for h in handles]

@@ -1,0 +1,110 @@
+"""Async-handle bookkeeping for the eager frontend.
+
+Parity with the reference's ``HandleManager``
+(reference: horovod/torch/handle_manager.h/.cc:22-53): atomic int handles
+mapped to results, backing Python ``poll()`` / ``synchronize()``.
+
+The TPU twist: JAX dispatch is *already* asynchronous — a dispatched
+collective returns a ``jax.Array`` future immediately.  A handle therefore
+moves through three states:
+
+  QUEUED      enqueued, waiting for the engine cycle to fuse + dispatch it
+  DISPATCHED  a jax.Array future exists; the chip may still be computing
+  DONE        result materialized (or an error captured)
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class _Entry:
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None           # jax.Array once dispatched
+    error: BaseException | None = None
+    dispatched: bool = False
+
+
+class HandleManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counter = itertools.count()
+        self._entries: dict[int, _Entry] = {}
+
+    def allocate(self) -> int:
+        """reference handle_manager.cc:22-27."""
+        h = next(self._counter)
+        with self._lock:
+            self._entries[h] = _Entry()
+        return h
+
+    def _get(self, handle: int) -> _Entry:
+        with self._lock:
+            try:
+                return self._entries[handle]
+            except KeyError:
+                raise ValueError(
+                    f"handle {handle} is unknown or already released"
+                ) from None
+
+    def mark_dispatched(self, handle: int, result: Any) -> None:
+        e = self._get(handle)
+        e.result = result
+        e.dispatched = True
+        e.event.set()
+
+    def mark_error(self, handle: int, err: BaseException) -> None:
+        e = self._get(handle)
+        e.error = err
+        e.dispatched = True
+        e.event.set()
+
+    def poll(self, handle: int) -> bool:
+        """Non-blocking readiness check (reference handle_manager.cc:35-39 +
+        the cudaEventQuery-style probe of ready_event.cc:34-92, which on TPU
+        is ``jax.Array.is_ready()``)."""
+        e = self._get(handle)
+        if not e.event.is_set():
+            return False
+        if e.error is not None:
+            return True
+        r = e.result
+        if hasattr(r, "is_ready"):
+            try:
+                return bool(r.is_ready())
+            except Exception:
+                return True
+        return True
+
+    def wait(self, handle: int, flush) -> Any:
+        """Block until done, release the handle, return the result.
+
+        ``flush`` is called first so queued-but-unfused work cannot deadlock —
+        the analogue of the reference's WaitAndClear poll loop
+        (torch/mpi_ops_v2.cc:228-234) except no polling is needed: we block
+        on the dispatch event, then on the device future.
+        """
+        flush()
+        e = self._get(handle)
+        e.event.wait()
+        try:
+            if e.error is not None:
+                raise e.error
+            result = e.result
+            if hasattr(result, "block_until_ready"):
+                result.block_until_ready()
+            return result
+        finally:
+            self.release(handle)
+
+    def release(self, handle: int) -> None:
+        with self._lock:
+            self._entries.pop(handle, None)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._entries)

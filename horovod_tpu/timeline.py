@@ -1,0 +1,160 @@
+"""Horovod Timeline — Chrome-tracing profiler for the eager engine.
+
+Parity with the reference timeline (reference: horovod/common/timeline.h/.cc,
+docs/timeline.md): a ``chrome://tracing`` JSON file written when
+``HOROVOD_TIMELINE=<path>`` is set, in which every named tensor is modeled as
+its own "process" (pid) whose track shows the phases of its collective:
+
+  NEGOTIATE_ALLREDUCE / NEGOTIATE_ALLGATHER / NEGOTIATE_BROADCAST
+      reference timeline.cc:98-132 — time between enqueue and the engine
+      deciding to run the op (here: time in the fusion queue until the cycle
+      flush picks the tensor up).
+  ALLREDUCE / ALLGATHER / BROADCAST  top-level op span
+  QUEUE / FUSE / DISPATCH / WAIT_FOR_OUTPUT
+      TPU-native activity vocabulary replacing the reference's
+      MEMCPY_IN_FUSION_BUFFER / NCCL_ALLREDUCE etc. (operations.h:29-46):
+      XLA owns the memcpys and the wire, so what the host can observe is
+      queue time, fusion grouping, dispatch (trace/compile/launch) and the
+      wait on the device future.
+
+Device-side detail (per-HLO timing, ICI traffic) belongs to the JAX/XLA
+profiler; :func:`trace_annotation` bridges engine phases into it so both
+timelines line up in TensorBoard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import TextIO
+
+import jax
+
+NEGOTIATE = "NEGOTIATE"
+QUEUE = "QUEUE"
+FUSE = "FUSE"
+DISPATCH = "DISPATCH"
+WAIT_FOR_OUTPUT = "WAIT_FOR_OUTPUT"
+
+
+class Timeline:
+    """Thread-safe Chrome-trace writer (reference timeline.cc:24-188).
+
+    Events are buffered and flushed at most every second (reference
+    timeline.cc flush cadence) or on close.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._lock = threading.Lock()
+        self._path = path
+        self._file: TextIO = open(path, "w")
+        self._file.write("[\n")
+        self._start = time.perf_counter()
+        self._pids: dict[str, int] = {}
+        self._next_pid = 1
+        self._buffer: list[str] = []
+        self._last_flush = time.monotonic()
+        self._closed = False
+
+    def _ts_us(self) -> float:
+        return (time.perf_counter() - self._start) * 1e6
+
+    def _pid(self, tensor_name: str) -> int:
+        pid = self._pids.get(tensor_name)
+        if pid is None:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._pids[tensor_name] = pid
+            # Tensor-as-process metadata event (reference timeline.cc:51-67).
+            self._emit(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": tensor_name},
+                }
+            )
+            self._emit(
+                {"name": "process_sort_index", "ph": "M", "pid": pid,
+                 "args": {"sort_index": pid}}
+            )
+        return pid
+
+    def _emit(self, event: dict) -> None:
+        self._buffer.append(json.dumps(event))
+        now = time.monotonic()
+        if now - self._last_flush > 1.0:
+            self._flush_locked()
+            self._last_flush = now
+
+    def _flush_locked(self) -> None:
+        if self._buffer:
+            self._file.write(",\n".join(self._buffer) + ",\n")
+            self._buffer.clear()
+            self._file.flush()
+
+    def start(self, tensor_name: str, activity: str, args: dict | None = None) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "B", "ts": self._ts_us(),
+                 "pid": self._pid(tensor_name), "tid": 0,
+                 **({"args": args} if args else {})}
+            )
+
+    def end(self, tensor_name: str, activity: str, args: dict | None = None) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "E", "ts": self._ts_us(),
+                 "pid": self._pid(tensor_name), "tid": 0,
+                 **({"args": args} if args else {})}
+            )
+
+    def instant(self, tensor_name: str, activity: str) -> None:
+        """Negotiation-tick instant event (reference timeline.cc:118-126)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "X", "ts": self._ts_us(), "dur": 0,
+                 "pid": self._pid(tensor_name), "tid": 0}
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._flush_locked()
+            # Chrome tracing tolerates a trailing comma with a closing ']'
+            # written on a fresh line; emit a terminator event for strictness.
+            self._file.write(json.dumps({"name": "done", "ph": "i", "ts": self._ts_us(), "pid": 0, "s": "g"}))
+            self._file.write("\n]\n")
+            self._file.close()
+
+
+def trace_annotation(name: str):
+    """Bridge an engine phase into the JAX/XLA profiler (TensorBoard trace).
+
+    The reference points users at chrome://tracing only; on TPU the XLA
+    profiler is the richer source, so engine phases are mirrored there.
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def maybe_create(path: str | None) -> Timeline | None:
+    """Create a timeline if configured.  Rank-0-only in multi-host jobs
+    (reference operations.cc:1614-1618 gates on is_coordinator)."""
+    if not path:
+        return None
+    if jax.process_index() != 0:
+        return None
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    return Timeline(path)

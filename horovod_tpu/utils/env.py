@@ -1,0 +1,87 @@
+"""Environment-variable configuration, read once at engine start.
+
+TPU-native re-design of the reference's env knob system
+(reference: horovod/common/operations.h:52-59, parsed in
+horovod/common/operations.cc:1614-1685).  The same knob names are kept so a
+Horovod user can bring their launch scripts across unchanged; TPU-specific
+knobs use the ``HOROVOD_TPU_`` prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# Knob names kept for parity with the reference.
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_SPARSE_ALLREDUCE = "HOROVOD_SPARSE_ALLREDUCE"
+
+# Defaults mirror reference horovod/common/operations.cc:151 (64 MiB fusion
+# buffer), :155 (5 ms cycle) and :273 (60 s stall warning).
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 5.0
+DEFAULT_STALL_WARNING_TIME_S = 60.0
+
+
+def _get_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Snapshot of all engine knobs, taken once when the engine starts.
+
+    Mirrors the one-shot parse at background-thread startup in the reference
+    (horovod/common/operations.cc:1614-1685).
+    """
+
+    timeline_file: str | None = None
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    stall_check_enabled: bool = True
+    stall_warning_time_s: float = DEFAULT_STALL_WARNING_TIME_S
+    hierarchical_allreduce: bool = False
+    sparse_allreduce: bool = False
+
+    @classmethod
+    def from_env(cls) -> "EngineConfig":
+        return cls(
+            timeline_file=os.environ.get(HOROVOD_TIMELINE) or None,
+            fusion_threshold_bytes=_get_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES
+            ),
+            cycle_time_ms=_get_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            stall_check_enabled=not _get_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_time_s=_get_float(
+                "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME_S
+            ),
+            hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            sparse_allreduce=_get_bool(HOROVOD_SPARSE_ALLREDUCE),
+        )

@@ -1,0 +1,38 @@
+"""Test harness: a virtual 8-device CPU mesh.
+
+The reference runs its whole suite under ``mpirun -np 2`` on one host
+(reference: .travis.yml; SURVEY.md §4) — multi-node simulated by multiple
+processes.  The TPU-native analogue is multiple XLA host devices in ONE
+process: ``--xla_force_host_platform_device_count=8`` gives an 8-"chip" CPU
+mesh on which every collective compiles and runs exactly as it would over
+ICI.
+
+Must run before any jax backend initialization; the axon TPU plugin forces
+``jax_platforms`` at interpreter start, so we override it back to cpu here.
+"""
+
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_world():
+    """Session-wide init — the analogue of hvd.init() at test-module import
+    (reference test/test_torch.py:33)."""
+    assert jax.device_count() == 8, (
+        "test harness expects 8 virtual CPU devices; check XLA_FLAGS ordering"
+    )
+    hvd.init()
+    yield
+    hvd.shutdown()

@@ -1,0 +1,96 @@
+"""Eager allgather (incl. ragged first dims) and broadcast —
+reference test/test_tensorflow.py:386-433 (allgather), :509-590 (broadcast)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_allgather_equal_shapes():
+    n = hvd.size()
+    x = hvd.per_rank(lambda r: jnp.full((2, 3), float(r)))
+    out = hvd.allgather(x)
+    assert out.shape == (2 * n, 3)
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(out[2 * r : 2 * r + 2]), r)
+
+
+def test_allgather_variable_first_dim():
+    """Ranks contribute different dim-0 sizes
+    (reference test_tensorflow.py:410-433; operations.cc:841-901)."""
+    n = hvd.size()
+    per_rank = [jnp.full((r + 1, 2), float(r)) for r in range(n)]
+    out = hvd.allgather(per_rank)
+    assert out.shape == (sum(r + 1 for r in range(n)), 2)
+    off = 0
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(out[off : off + r + 1]), r)
+        off += r + 1
+
+
+def test_allgather_int_dtype():
+    n = hvd.size()
+    out = hvd.allgather(hvd.per_rank(lambda r: jnp.asarray([r, r], jnp.int32)))
+    assert np.asarray(out).tolist() == [v for r in range(n) for v in (r, r)]
+
+
+def test_allgather_mismatched_trailing_dims_raises():
+    per_rank = [jnp.zeros((1, 2))] * (hvd.size() - 1) + [jnp.zeros((1, 3))]
+    with pytest.raises(ValueError, match="agree on all dims"):
+        hvd.allgather(per_rank)
+
+
+def test_allgather_mismatched_dtype_raises():
+    per_rank = [jnp.zeros((1, 2), jnp.float32)] * (hvd.size() - 1) + [
+        jnp.zeros((1, 2), jnp.int32)
+    ]
+    with pytest.raises(ValueError, match="dtype"):
+        hvd.allgather(per_rank)
+
+
+@pytest.mark.parametrize("root", [0, 1, 7])
+def test_broadcast_value_identity(root):
+    """Every rank ends with the root's tensor
+    (reference test_tensorflow.py:509-538)."""
+    x = hvd.per_rank(lambda r: jnp.full((2, 2), float(r * 10 + 1)))
+    out = hvd.broadcast(x, root_rank=root)
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 2), root * 10 + 1.0))
+
+
+def test_broadcast_bool_and_int():
+    x = hvd.per_rank(lambda r: jnp.asarray([r % 2 == 0, r % 3 == 0]))
+    out = hvd.broadcast(x, root_rank=3)
+    assert np.asarray(out).tolist() == [False, True]
+    xi = hvd.per_rank(lambda r: jnp.asarray([r], jnp.int32))
+    assert np.asarray(hvd.broadcast(xi, root_rank=5)).tolist() == [5]
+
+
+def test_broadcast_rank_validation():
+    """Invalid root errors (reference test_tensorflow.py:575-590)."""
+    x = hvd.per_rank(lambda r: jnp.asarray([1.0]))
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(x, root_rank=hvd.size())
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(x, root_rank=-1)
+
+
+def test_sparse_allreduce_dense_equivalence():
+    """ratio=1.0 top-k == dense allreduce (fork's sparse path,
+    reference torch/__init__.py:46-83)."""
+    n = hvd.size()
+    x = hvd.per_rank(lambda r: jnp.arange(1.0, 13.0) * (r + 1))
+    out = hvd.sparse_allreduce(x, ratio=1.0)
+    expected = np.arange(1.0, 13.0) * sum(r + 1 for r in range(n))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_sparse_allreduce_topk_selects_largest():
+    """With k=1 each rank contributes only its largest-|.| element."""
+    base = np.asarray([0.1, 0.2, 5.0, 0.3])
+    x = hvd.per_rank(lambda r: jnp.asarray(base))
+    out = hvd.sparse_allreduce(x, k=1)
+    expected = np.zeros(4)
+    expected[2] = 5.0 * hvd.size()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)

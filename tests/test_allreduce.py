@@ -1,0 +1,121 @@
+"""Eager allreduce correctness — the matrix of
+reference test/test_tensorflow.py:56-120 and test/test_torch.py sync/average/
+fused tests, on the 8-device CPU mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+DTYPES = [jnp.float32, jnp.int32, jnp.bfloat16]  # no x64 on TPU
+DIMS = [1, 2, 3]
+
+
+def _tolerance(dtype):
+    # Size-dependent float thresholds, as in reference test_tensorflow.py:62-71.
+    if dtype in (jnp.float16, jnp.bfloat16):
+        return 1e-1 * hvd.size()
+    if dtype in (jnp.float32, jnp.float64):
+        return 1e-5 * hvd.size()
+    return 0
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("dim", DIMS)
+def test_allreduce_sum(dtype, dim):
+    n = hvd.size()
+    rng = np.random.RandomState(1234 + dim)
+    per_rank = [
+        (rng.uniform(-100, 100, size=(4,) * dim)).astype(np.float64)
+        for _ in range(n)
+    ]
+    per_rank = [jnp.asarray(p, dtype=dtype) for p in per_rank]
+    x = hvd.from_per_rank(per_rank)
+    out = hvd.allreduce(x, average=False)
+    expected = np.sum([np.asarray(p, np.float64) for p in per_rank], axis=0)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float64), expected, atol=float(_tolerance(dtype)) + 1e-12
+    )
+
+
+def test_allreduce_average():
+    n = hvd.size()
+    x = hvd.per_rank(lambda r: jnp.full((3, 3), float(r)))
+    out = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(np.asarray(out), np.full((3, 3), (n - 1) / 2.0), rtol=1e-6)
+
+
+def test_allreduce_min_max_product():
+    x = hvd.per_rank(lambda r: jnp.asarray([r + 1.0, -(r + 1.0)]))
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Min)), [1.0, -8.0])
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Max)), [8.0, -1.0])
+    prod = hvd.allreduce(hvd.per_rank(lambda r: jnp.asarray([2.0])), op=hvd.Product)
+    np.testing.assert_allclose(np.asarray(prod), [2.0 ** hvd.size()])
+
+
+@pytest.mark.parametrize("comp", [hvd.Compression.fp16, hvd.Compression.bf16])
+def test_allreduce_compressed_roundtrip(comp):
+    """fp16 compression round-trip (reference test_tensorflow.py:626-665):
+    output dtype matches input, value within 16-bit tolerance."""
+    x = hvd.per_rank(lambda r: jnp.linspace(-1.0, 1.0, 64).astype(jnp.float32) * (r + 1))
+    out = hvd.allreduce(x, average=False, compression=comp)
+    assert out.dtype == jnp.float32
+    expected = np.sum(
+        [np.linspace(-1, 1, 64) * (r + 1) for r in range(hvd.size())], axis=0
+    )
+    # 16-bit wire tolerance: bf16 ulp at |36| is 0.25 (8-bit mantissa).
+    np.testing.assert_allclose(np.asarray(out), expected, atol=0.35)
+
+
+def test_allreduce_async_poll_synchronize():
+    """Handle lifecycle (reference test_torch.py test_horovod_allreduce_async
+    and torch/mpi_ops.py:406-438)."""
+    x = hvd.per_rank(lambda r: jnp.asarray([float(r)]))
+    h = hvd.allreduce_async(x, name="poll_me")
+    # poll() flushes, so it must eventually turn true without synchronize.
+    for _ in range(1000):
+        if hvd.poll(h):
+            break
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), [sum(range(hvd.size()))])
+    with pytest.raises(ValueError):
+        hvd.poll(h)  # released
+
+
+def test_allreduce_fused_many():
+    """Many small tensors in one cycle fuse and still produce exact sums
+    (reference test_torch.py:175-224 test_horovod_allreduce_async_fused)."""
+    n = hvd.size()
+    handles = []
+    expectations = []
+    for i in range(33):
+        shape = (i % 5 + 1, 3)
+        x = hvd.per_rank(lambda r, i=i, shape=shape: jnp.full(shape, float(r + i)))
+        handles.append(hvd.allreduce_async(x, name=f"fused.{i}"))
+        expectations.append(np.full(shape, float(sum(range(n)) + i * n)))
+    for h, exp in zip(handles, expectations):
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), exp, rtol=1e-6)
+
+
+def test_allreduce_grouped_mixed_dtypes():
+    xs = [
+        hvd.per_rank(lambda r: jnp.asarray([float(r)], jnp.float32)),
+        hvd.per_rank(lambda r: jnp.asarray([r], jnp.int32)),
+        hvd.per_rank(lambda r: jnp.asarray([float(r) * 2], jnp.float32)),
+    ]
+    outs = hvd.grouped_allreduce_eager(xs)
+    s = sum(range(hvd.size()))
+    np.testing.assert_allclose(np.asarray(outs[0]), [float(s)])
+    assert np.asarray(outs[1]).tolist() == [s]
+    np.testing.assert_allclose(np.asarray(outs[2]), [2.0 * s])
+
+
+def test_allreduce_rejects_non_rank_major():
+    """Shape mismatch is an error, not a hang — the analogue of the
+    reference's FailedPrecondition negative tests (test_tensorflow.py:249-320)."""
+    with pytest.raises(ValueError, match="rank-major"):
+        hvd.allreduce(jnp.ones((3, 2)))
+    with pytest.raises(ValueError, match="rank-major"):
+        hvd.allreduce(jnp.float32(1.0))

@@ -1,0 +1,113 @@
+"""Fusion bucketing plan + basics/process-model tests
+(reference operations.cc:1916-1943 merge loop; common/__init__.py basics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import fusion
+
+
+def test_plan_buckets_threshold():
+    ts = [jnp.zeros((1024,), jnp.float32) for _ in range(10)]  # 4 KiB each
+    plan = fusion.plan_buckets(ts, threshold_bytes=8 * 1024)
+    assert all(len(b) == 2 for b in plan)
+    assert [i for b in plan for i in b] == list(range(10))
+
+
+def test_plan_buckets_dtype_boundary():
+    ts = [
+        jnp.zeros((8,), jnp.float32),
+        jnp.zeros((8,), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+        jnp.zeros((8,), jnp.float32),
+    ]
+    plan = fusion.plan_buckets(ts, threshold_bytes=1 << 20)
+    assert plan == [[0, 1], [2], [3]]
+
+
+def test_plan_buckets_oversize_tensor_own_bucket():
+    ts = [jnp.zeros((100,), jnp.float32), jnp.zeros((1000,), jnp.float32)]
+    plan = fusion.plan_buckets(ts, threshold_bytes=512)
+    assert plan == [[0], [1]]
+
+
+def test_plan_buckets_fusion_disabled():
+    ts = [jnp.zeros((4,), jnp.float32) for _ in range(3)]
+    plan = fusion.plan_buckets(ts, threshold_bytes=0)
+    assert plan == [[0], [1], [2]]
+
+
+def test_fused_apply_identity_preserves_values():
+    ts = [jnp.arange(5.0), jnp.ones((2, 3)), jnp.arange(4.0).reshape(2, 2)]
+    outs = fusion.fused_apply(ts, lambda flat: flat * 2.0)
+    for t, o in zip(ts, outs):
+        assert o.shape == t.shape
+        np.testing.assert_allclose(np.asarray(o), np.asarray(t) * 2.0)
+
+
+def test_basics_world_shape():
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.mpi_threads_supported() is True
+    assert hvd.is_initialized()
+
+
+def test_double_init_is_idempotent():
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_from_per_rank_validation():
+    with pytest.raises(ValueError, match="per-rank"):
+        hvd.from_per_rank([jnp.zeros(2)] * 3)
+
+
+def test_from_per_rank_sharding():
+    x = hvd.per_rank(lambda r: jnp.asarray([float(r)]))
+    assert x.shape == (8, 1)
+    assert len(x.sharding.device_set) == 8
+
+
+def test_engine_config_env(monkeypatch):
+    from horovod_tpu.utils.env import EngineConfig
+
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    monkeypatch.setenv("HOROVOD_TIMELINE", "/tmp/tl.json")
+    cfg = EngineConfig.from_env()
+    assert cfg.fusion_threshold_bytes == 1024
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.stall_check_enabled is False
+    assert cfg.timeline_file == "/tmp/tl.json"
+
+
+def test_timeline_writes_chrome_trace(tmp_path):
+    """Timeline output is valid Chrome-trace JSON with tensor pids
+    (reference timeline.cc:24-188, docs/timeline.md)."""
+    import json
+
+    from horovod_tpu.timeline import Timeline
+
+    path = tmp_path / "timeline.json"
+    tl = Timeline(str(path))
+    tl.start("grad/w1", "NEGOTIATE_ALLREDUCE")
+    tl.instant("grad/w1", "2")
+    tl.end("grad/w1", "NEGOTIATE_ALLREDUCE")
+    tl.start("grad/w1", "ALLREDUCE", {"dtype": "float32"})
+    tl.end("grad/w1", "ALLREDUCE")
+    tl.close()
+    events = json.loads(path.read_text())
+    names = [e["name"] for e in events]
+    assert "process_name" in names
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    meta = next(e for e in events if e["name"] == "process_name")
+    assert meta["args"]["name"] == "grad/w1"
