@@ -20,8 +20,10 @@ Quick start (the reference's canonical recipe, examples/pytorch_mnist.py)::
 
     import horovod_tpu as hvd
     hvd.init()
-    x = hvd.per_rank(lambda r: grad_shard_for(r))   # rank-major tensor
-    g = hvd.allreduce(x, average=True)              # fused psum over ICI
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    step = hvd.make_train_step(loss_fn, tx)   # compiled SPMD over the mesh
+    params, opt_state, loss = step(params, opt_state, batch)  # batch rank-major
 """
 
 from horovod_tpu.basics import (  # noqa: F401
@@ -65,6 +67,31 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     sparse_allreduce,
     sparse_allreduce_async,
     synchronize,
+)
+from horovod_tpu.optim.distributed_optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    TrainStepResult,
+    allreduce_gradients,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    make_train_step,
+)
+from horovod_tpu.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    average_metrics,
+    multiplier_schedule,
+    warmup_schedule,
+)
+from horovod_tpu.checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    load_model,
+    restore_checkpoint,
+    save_checkpoint,
 )
 from horovod_tpu import ops  # noqa: F401
 

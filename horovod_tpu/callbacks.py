@@ -1,0 +1,232 @@
+"""Training-loop callbacks and LR schedules.
+
+Parity with the reference Keras callback set
+(reference: horovod/_keras/callbacks.py:1-168 and the public wrappers in
+horovod/keras/callbacks.py / horovod/tensorflow/keras/callbacks.py):
+
+* ``BroadcastGlobalVariablesCallback``  — state sync at train begin
+* ``MetricAverageCallback``             — epoch-end metric allreduce
+* ``LearningRateScheduleCallback``      — multiplier schedule (staircase or
+  smooth) with momentum correction
+* ``LearningRateWarmupCallback``        — gradual ``lr → lr·size`` ramp
+
+TPU-native design: schedules are *pure functions of the step* so they can
+live inside the compiled train step — exposed both as optax schedules
+(:func:`warmup_schedule`, :func:`multiplier_schedule`) and as callback
+objects with the reference's epoch-driven API for eager-style loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Mapping
+
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu import basics
+from horovod_tpu.ops import eager as eager_ops
+from horovod_tpu.optim.distributed_optimizer import broadcast_parameters
+
+
+# ---------------------------------------------------------------------------
+# optax schedules (the compiled-path form).
+# ---------------------------------------------------------------------------
+
+
+def warmup_schedule(
+    base_lr: float,
+    *,
+    size: int | None = None,
+    warmup_epochs: float = 5.0,
+    steps_per_epoch: int,
+    verbose: bool = False,
+) -> optax.Schedule:
+    """Gradual ``lr → lr·size`` warm-up ramp.
+
+    Reference formula (``_keras/callbacks.py:149-168``):
+    ``lr = base_lr · size · (epoch·(size-1)/warmup + 1) / size`` — i.e. a
+    linear interpolation from ``base_lr`` at epoch 0 to ``base_lr·size``
+    after ``warmup_epochs``.  Returns an optax schedule over *steps*.
+    """
+    del verbose
+    n = size if size is not None else basics.size()
+
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        ramp = jnp.minimum(epoch / warmup_epochs, 1.0)
+        return base_lr * (1.0 + ramp * (n - 1))
+
+    return schedule
+
+
+def multiplier_schedule(
+    base_lr: float,
+    multiplier: Callable[[float], float] | float,
+    *,
+    start_epoch: float = 0.0,
+    end_epoch: float | None = None,
+    steps_per_epoch: int,
+    staircase: bool = True,
+) -> optax.Schedule:
+    """Epoch-window multiplier schedule
+    (reference ``LearningRateScheduleCallbackImpl``, _keras/callbacks.py:70-146).
+
+    ``multiplier`` is a constant or a function of epoch; ``staircase`` feeds
+    it integer epochs, otherwise smooth fractional epochs (reference
+    :103-116).  Composable: sum several windows with optax.join_schedules.
+    """
+
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        if staircase:
+            epoch = jnp.floor(epoch)
+        if callable(multiplier):
+            m = multiplier(epoch)
+        else:
+            m = multiplier
+        in_window = (epoch >= start_epoch) & (
+            (end_epoch is None) | (epoch < (end_epoch or math.inf))
+        )
+        return jnp.where(in_window, base_lr * m, base_lr)
+
+    return schedule
+
+
+# ---------------------------------------------------------------------------
+# Callback objects (the eager/epoch-driven form, reference API shape).
+# ---------------------------------------------------------------------------
+
+
+class Callback:
+    """Minimal callback protocol for eager training loops (the shape of
+    keras.callbacks.Callback that the reference builds on)."""
+
+    def on_train_begin(self, state: Any) -> Any:
+        return state
+
+    def on_epoch_begin(self, epoch: int, state: Any) -> Any:
+        return state
+
+    def on_batch_begin(self, batch: int, state: Any) -> Any:
+        return state
+
+    def on_epoch_end(self, epoch: int, state: Any, metrics: dict) -> dict:
+        return metrics
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync all state from root at train begin
+    (reference _keras/callbacks.py:20-30)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        return broadcast_parameters(state, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over ranks (reference _keras/callbacks.py:33-67).
+
+    Works on rank-major metric arrays (eager) or plain scalars in
+    single-host jobs (already global)."""
+
+    def on_epoch_end(self, epoch, state, metrics):
+        del epoch, state
+        return average_metrics(metrics)
+
+
+def average_metrics(metrics: Mapping[str, Any]) -> dict:
+    """Eager allreduce-average of a metrics dict; rank-major values are
+    averaged over ranks, plain scalars pass through replicated."""
+    out = {}
+    n = basics.size()
+    for k, v in metrics.items():
+        arr = jnp.asarray(v)
+        if arr.ndim >= 1 and arr.shape[0] == n:
+            out[k] = eager_ops.allreduce(arr, average=True, name=f"metric.{k}")
+        else:
+            out[k] = arr
+    return out
+
+
+class LearningRateWarmupCallback(Callback):
+    """Epoch-driven warm-up mirror of :func:`warmup_schedule`
+    (reference _keras/callbacks.py:149-168).  Mutates a ``state.lr`` field
+    via ``set_lr`` if provided, else returns the LR from ``current_lr``."""
+
+    def __init__(self, base_lr: float, warmup_epochs: float = 5.0,
+                 size: int | None = None, set_lr=None, verbose: bool = False):
+        self.base_lr = base_lr
+        self.warmup_epochs = warmup_epochs
+        self.size = size if size is not None else basics.size()
+        self.set_lr = set_lr
+        self.verbose = verbose
+
+    def current_lr(self, epoch: float) -> float:
+        ramp = min(epoch / self.warmup_epochs, 1.0)
+        return self.base_lr * (1.0 + ramp * (self.size - 1))
+
+    def on_epoch_begin(self, epoch, state):
+        if epoch > self.warmup_epochs:
+            # Outside the warm-up window the callback must NO-OP so stacked
+            # schedule callbacks can own the LR (the reference warmup is a
+            # windowed schedule ending at warmup_epochs, _keras/callbacks.py
+            # :149-168).
+            return state
+        lr = self.current_lr(epoch)
+        if self.verbose and basics.rank() == 0:
+            print(f"Epoch {epoch}: LearningRateWarmup sets lr={lr:.6g}")
+        if self.set_lr is not None:
+            state = self.set_lr(state, lr)
+        return state
+
+
+class LearningRateScheduleCallback(Callback):
+    """Epoch-window multiplier (reference _keras/callbacks.py:70-146), with
+    the momentum-correction knob: when LR changes by factor f, rescale
+    momentum buffers by f so accumulated velocity stays consistent
+    (reference :126-138)."""
+
+    def __init__(self, base_lr: float, multiplier, start_epoch: float = 0.0,
+                 end_epoch: float | None = None, staircase: bool = True,
+                 momentum_correction: bool = True, set_lr=None,
+                 scale_momentum=None):
+        self.base_lr = base_lr
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.set_lr = set_lr
+        self.scale_momentum = scale_momentum
+        self._last_lr: float | None = None
+
+    def current_lr(self, epoch: float) -> float | None:
+        """LR inside the window; None outside (callback must no-op there so
+        stacked windowed callbacks don't clobber each other — the reference
+        impl returns early when out of window, _keras/callbacks.py:98-101)."""
+        e = math.floor(epoch) if self.staircase else epoch
+        in_window = e >= self.start_epoch and (
+            self.end_epoch is None or e < self.end_epoch
+        )
+        if not in_window:
+            return None
+        m = self.multiplier(e) if callable(self.multiplier) else self.multiplier
+        return self.base_lr * m
+
+    def on_epoch_begin(self, epoch, state):
+        lr = self.current_lr(epoch)
+        if lr is None:
+            return state
+        if self.set_lr is not None:
+            state = self.set_lr(state, lr)
+        if (
+            self.momentum_correction
+            and self.scale_momentum is not None
+            and self._last_lr not in (None, lr)
+        ):
+            state = self.scale_momentum(state, lr / self._last_lr)
+        self._last_lr = lr
+        return state

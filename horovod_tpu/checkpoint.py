@@ -1,0 +1,92 @@
+"""Checkpoint / resume conventions.
+
+The reference delegates checkpointing to the frameworks but establishes the
+conventions (SURVEY.md §5): rank-0-only writes
+(reference examples/tensorflow_mnist.py:106-108, keras_imagenet_resnet50.py:157),
+resume = find last checkpoint, broadcast the resume epoch, load on root,
+broadcast state to all (keras_imagenet_resnet50.py:66-73,
+pytorch_imagenet_resnet50.py:134-142), and ``hvd.load_model`` which re-wraps
+the optimizer with ``DistributedOptimizer`` on load
+(horovod/keras/__init__.py:115-148).
+
+TPU-native: orbax-backed, with the same conventions as helpers.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+
+from horovod_tpu import basics
+from horovod_tpu.optim.distributed_optimizer import (
+    DistributedOptimizer,
+    broadcast_object,
+    broadcast_parameters,
+)
+
+
+def _ckpt(path: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer(), os.path.abspath(path)
+
+
+def save_checkpoint(path: str, state: Any, *, step: int | None = None) -> str | None:
+    """Write a checkpoint from rank 0 only (the reference convention:
+    ``if hvd.rank() == 0: saver.save(...)``).  Returns the path written, or
+    None on non-root processes."""
+    basics._require_init()
+    if basics.cross_rank() != 0:
+        return None
+    checkpointer, base = _ckpt(path)
+    target = os.path.join(base, f"step_{step}") if step is not None else base
+    state = jax.device_get(state)
+    checkpointer.save(target, state, force=True)
+    return target
+
+
+def latest_checkpoint(path: str) -> str | None:
+    """Find the newest ``step_N`` checkpoint under ``path`` (the resume scan
+    of reference keras_imagenet_resnet50.py:66-70), agreed across hosts."""
+    basics._require_init()
+    found = None
+    if basics.cross_rank() == 0 and os.path.isdir(path):
+        steps = []
+        for entry in os.listdir(path):
+            m = re.fullmatch(r"step_(\d+)", entry)
+            if m:
+                steps.append(int(m.group(1)))
+        if steps:
+            found = os.path.join(os.path.abspath(path), f"step_{max(steps)}")
+    return broadcast_object(found, root_rank=0)
+
+
+def restore_checkpoint(path: str, template: Any = None, *, root_rank: int = 0) -> Any:
+    """Load on root, broadcast to every process, re-place on the mesh — the
+    reference's load-then-``broadcast_parameters`` resume recipe
+    (pytorch_imagenet_resnet50.py:134-142) as one call."""
+    basics._require_init()
+    checkpointer, base = _ckpt(path)
+    # Every process restores the same file set (orbax handles distributed
+    # reads); the broadcast then guarantees bit-identity across hosts.
+    state = (
+        checkpointer.restore(base, item=template)
+        if template is not None
+        else checkpointer.restore(base)
+    )
+    return broadcast_parameters(state, root_rank)
+
+
+def load_model(path: str, optimizer, template: Any = None, **dist_kwargs):
+    """Restore a training state AND re-wrap its optimizer with
+    ``DistributedOptimizer`` — parity with ``hvd.load_model``
+    (reference horovod/keras/__init__.py:115-148), which exists so users
+    can't accidentally resume with an un-distributed optimizer.
+
+    Returns ``(state, distributed_optimizer)``.
+    """
+    state = restore_checkpoint(path, template)
+    return state, DistributedOptimizer(optimizer, **dist_kwargs)

@@ -1,0 +1,258 @@
+"""DistributedOptimizer — data-parallel gradient averaging, optax-native.
+
+TPU-native re-design of the reference's optimizer wrappers:
+* TF graph mode: ``DistributedOptimizer.compute_gradients`` allreduces each
+  gradient (reference horovod/tensorflow/__init__.py:135-225).
+* PyTorch: per-parameter grad hooks fire ``allreduce_async_`` during
+  backward; ``step()`` synchronizes (reference horovod/torch/__init__.py:86-227).
+* Fork extras: ``is_sparse`` top-k mode (:141-151, 202-216) and the
+  ``local`` no-communication flag (:115, 158).
+
+On TPU the optimizer lives inside ONE compiled SPMD program, so "hook per
+gradient + background fusion" collapses into a gradient transformation:
+``DistributedOptimizer(tx)`` returns an ``optax.GradientTransformation``
+whose ``update`` all-reduces the gradient pytree over the mesh axis (fused
+into ≤ threshold buckets, compression applied) before delegating to ``tx``.
+XLA then overlaps those psums with the backward pass the same way Horovod
+overlaps NCCL with autograd — but scheduled by the compiler, not a cycle
+thread.
+
+Use inside ``shard_map``/``pjit`` over a mesh with the data axis, or via
+:func:`make_train_step`, which builds the canonical step function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu import basics
+from horovod_tpu.basics import AXIS_NAME
+from horovod_tpu.ops import collective_ops
+from horovod_tpu.ops.collective_ops import Average, Sum, _ReduceOp
+from horovod_tpu.ops.compression import Compression, TopKCompressor
+
+
+def allreduce_gradients(
+    grads: Any,
+    *,
+    op: _ReduceOp = Average,
+    axis_name=AXIS_NAME,
+    compression=Compression.none,
+    fusion_threshold_bytes: int | None = None,
+    sparse: bool = False,
+    sparse_ratio: float = 0.01,
+) -> Any:
+    """All-reduce a gradient pytree over the mesh axis, fused.
+
+    The in-graph analogue of the reference's per-gradient
+    ``hvd.allreduce(grad, average=True, compression=...)`` loop
+    (tensorflow/__init__.py:183-209), with Tensor Fusion applied
+    structurally: leaves are bucketed (same dtype, ≤ threshold bytes) and
+    each bucket is ONE psum (operations.cc:1916-1943's merge, compiled).
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    if sparse:
+        topk = TopKCompressor(ratio=sparse_ratio)
+        reduced = [
+            topk.sparse_allreduce(g, average=op is Average, axis_name=axis_name)
+            for g in leaves
+        ]
+    else:
+        reduced = collective_ops.grouped_allreduce(
+            leaves,
+            op=op,
+            axis_name=axis_name,
+            compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+        )
+    return jax.tree.unflatten(treedef, reduced)
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    op: _ReduceOp = Average,
+    axis_name=AXIS_NAME,
+    compression=Compression.none,
+    fusion_threshold_bytes: int | None = None,
+    is_sparse: bool = False,
+    sparse_ratio: float = 0.01,
+    local: bool = False,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates see globally-averaged gradients.
+
+    Parity table with the reference wrapper kwargs:
+
+    ================  =========================================================
+    reference                         here
+    ================  =========================================================
+    ``compression``    ``compression=`` (none / fp16 / bf16)
+    ``sparse_as_dense``  not needed — JAX gradients are dense pytrees
+    fork ``is_sparse``   ``is_sparse=True`` + ``sparse_ratio`` (top-k path)
+    fork ``self.local``  ``local=True`` skips communication entirely
+    ``device_dense`` …  owned by XLA (no device staging knobs on TPU)
+    ================  =========================================================
+
+    Must run inside SPMD code where ``axis_name`` is bound (shard_map/pjit
+    over the hvd mesh) — the analogue of "must run under mpirun".
+    """
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(grads, state, params=None, **extra):
+        if not local:
+            reduced = allreduce_gradients(
+                grads,
+                op=op,
+                axis_name=axis_name,
+                compression=compression,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                sparse=is_sparse,
+                sparse_ratio=sparse_ratio,
+            )
+        else:
+            reduced = grads
+        return optimizer.update(reduced, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class TrainStepResult(NamedTuple):
+    params: Any
+    opt_state: Any
+    loss: jax.Array
+
+
+def make_train_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh: jax.sharding.Mesh | None = None,
+    axis_name: str = AXIS_NAME,
+    donate: bool = True,
+) -> Callable[..., TrainStepResult]:
+    """Build the canonical data-parallel train step, compiled over the mesh.
+
+    ``loss_fn(params, batch) -> scalar`` is the user's per-shard loss;
+    ``optimizer`` is typically ``DistributedOptimizer(...)``.  The returned
+    function takes ``(params, opt_state, batch)`` where ``batch`` leaves are
+    rank-major (dim 0 == world size × local batch) and params/opt_state are
+    replicated; it returns updated replicated params, opt_state, and the
+    globally-averaged loss.
+
+    This is the whole L5→L2 stack of the reference collapsed into one
+    compiled program: examples/tensorflow_mnist.py:85's
+    ``opt.minimize(loss)`` → stack §3.2 of SURVEY.md.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        mesh = basics.mesh()
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        mean_loss = collective_ops.allreduce(loss, op=Average, axis_name=axis_name)
+        return TrainStepResult(params, opt_state, mean_loss)
+
+    smapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=TrainStepResult(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# State broadcast: model init sync and optimizer-state sync.
+# ---------------------------------------------------------------------------
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Make every process agree with the root's parameter pytree.
+
+    Parity with reference ``hvd.broadcast_parameters``
+    (horovod/torch/__init__.py:270-299) / ``BroadcastGlobalVariablesHook``
+    (tensorflow/__init__.py:101-132).
+
+    Single-controller: the controller already holds THE copy, so this
+    re-places leaves with replicated sharding over the mesh (the
+    device-broadcast XLA would emit) and returns them.  Multi-controller:
+    process 0's values are broadcast to all hosts over DCN
+    (``multihost_utils.broadcast_one_to_all``), matching root_rank
+    semantics for the host that owns device ``root_rank``.
+    """
+    basics._require_init()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        root_process = list(basics.mesh().devices.flat)[root_rank].process_index
+        if root_process != 0:
+            raise NotImplementedError(
+                "multi-host broadcast_parameters currently requires the root "
+                "device to live on process 0"
+            )
+        return multihost_utils.broadcast_one_to_all(params)
+    sharding = basics.replicated_sharding()
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), params)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast an optax optimizer state pytree.
+
+    The reference needs 100 lines of scalar→tensor wrapping and recursive
+    cast callbacks because torch optimizer state mixes tensors and Python
+    scalars (torch/__init__.py:302-418).  optax states are pytrees, so the
+    only special case is non-array leaves (step counts as Python ints):
+    they are wrapped, broadcast, and cast back.
+    """
+    basics._require_init()
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(opt_state)
+    py_types = [None if isinstance(l, jax.Array) else type(l) for l in leaves]
+    wrapped = [jnp.asarray(l) for l in leaves]
+    out = broadcast_parameters(wrapped, root_rank)
+
+    def _restore(t, leaf):
+        if t is None:
+            return leaf
+        if issubclass(t, np.ndarray):
+            # np.ndarray(x) is the low-level buffer constructor (treats ints
+            # as a shape!); np.asarray is the value-preserving conversion.
+            return np.asarray(leaf)
+        return t(leaf)
+
+    restored = [_restore(t, leaf) for t, leaf in zip(py_types, out)]
+    return jax.tree.unflatten(treedef, restored)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Broadcast an arbitrary picklable object (the resume-epoch pattern of
+    reference examples/keras_imagenet_resnet50.py:66-73)."""
+    basics._require_init()
+    if jax.process_count() == 1:
+        return obj
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    if basics.cross_rank() == 0:
+        payload = jnp.frombuffer(pickle.dumps(obj), dtype=jnp.uint8)
+        length = jnp.asarray([payload.size], jnp.int32)
+    else:
+        payload = jnp.zeros((0,), jnp.uint8)
+        length = jnp.asarray([0], jnp.int32)
+    n = int(multihost_utils.broadcast_one_to_all(length)[0])
+    if basics.cross_rank() != 0:
+        payload = jnp.zeros((n,), jnp.uint8)
+    data = multihost_utils.broadcast_one_to_all(payload)
+    return pickle.loads(bytes(bytearray(jax.device_get(data))))

@@ -1,0 +1,147 @@
+"""Callbacks, LR schedules, and checkpoint conventions —
+reference _keras/callbacks.py tests + the load_model rewrap tests of
+test/test_keras.py:60-244."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+
+
+def test_warmup_schedule_ramp():
+    """lr ramps from base_lr to base_lr*size over warmup_epochs
+    (reference _keras/callbacks.py:149-168)."""
+    sched = hvd.warmup_schedule(0.1, size=8, warmup_epochs=5, steps_per_epoch=10)
+    np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(25)), 0.1 * (1 + 0.5 * 7), rtol=1e-6)
+    np.testing.assert_allclose(float(sched(50)), 0.8, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(500)), 0.8, rtol=1e-6)  # clamps
+
+
+def test_multiplier_schedule_staircase_window():
+    sched = hvd.multiplier_schedule(
+        0.1, lambda e: 0.5 ** e, start_epoch=1, end_epoch=3,
+        steps_per_epoch=10, staircase=True,
+    )
+    np.testing.assert_allclose(float(sched(5)), 0.1, rtol=1e-6)  # epoch 0: outside window
+    np.testing.assert_allclose(float(sched(10)), 0.05, rtol=1e-6)   # epoch 1
+    np.testing.assert_allclose(float(sched(25)), 0.025, rtol=1e-6)  # epoch 2
+    np.testing.assert_allclose(float(sched(30)), 0.1, rtol=1e-6)  # epoch 3: window closed
+
+
+def test_metric_average_callback():
+    cb = hvd.MetricAverageCallback()
+    metrics = {
+        "loss": hvd.per_rank(lambda r: jnp.asarray(float(r))),
+        "global_step": 5,
+    }
+    out = cb.on_epoch_end(0, None, metrics)
+    np.testing.assert_allclose(float(out["loss"]), 3.5)
+    assert int(out["global_step"]) == 5
+
+
+def test_broadcast_callback_and_warmup_callback():
+    state = {"w": jnp.ones(3)}
+    cb = hvd.BroadcastGlobalVariablesCallback(0)
+    out = cb.on_train_begin(state)
+    assert len(out["w"].sharding.device_set) == 8
+
+    captured = {}
+
+    def set_lr(state, lr):
+        captured["lr"] = lr
+        return state
+
+    warm = hvd.LearningRateWarmupCallback(0.1, warmup_epochs=4, size=8, set_lr=set_lr)
+    warm.on_epoch_begin(2, state)
+    np.testing.assert_allclose(captured["lr"], 0.1 * (1 + 0.5 * 7), rtol=1e-6)
+
+
+def test_lr_schedule_momentum_correction():
+    """Momentum buffers rescale by the LR ratio when the LR steps
+    (reference _keras/callbacks.py:126-138)."""
+    events = []
+    cb = hvd.LearningRateScheduleCallback(
+        0.4,
+        lambda e: 0.1 if e >= 1 else 1.0,
+        set_lr=lambda s, lr: (events.append(("lr", lr)), s)[1],
+        scale_momentum=lambda s, f: (events.append(("mom", round(f, 6))), s)[1],
+    )
+    s = {}
+    s = cb.on_epoch_begin(0, s)
+    s = cb.on_epoch_begin(1, s)
+    lrs = [v for k, v in events if k == "lr"]
+    np.testing.assert_allclose(lrs, [0.4, 0.04], rtol=1e-6)
+    assert any(k == "mom" and abs(v - 0.1) < 1e-6 for k, v in events)
+
+
+def test_stacked_windowed_callbacks_no_clobber():
+    """Warmup + windowed schedules stack without overwriting each other
+    (the reference keras_imagenet_resnet50 callback stack)."""
+    sets = []
+    mk = lambda tag: (lambda s, lr: (sets.append((tag, lr)), s)[1])
+    warm = hvd.LearningRateWarmupCallback(0.1, warmup_epochs=5, size=8,
+                                          set_lr=mk("warm"))
+    sched = hvd.LearningRateScheduleCallback(0.8, 0.1, start_epoch=30,
+                                             end_epoch=60, set_lr=mk("sched"))
+    state = {}
+    for epoch in [0, 3, 10, 35]:
+        state = warm.on_epoch_begin(epoch, state)
+        state = sched.on_epoch_begin(epoch, state)
+    tags = [t for t, _ in sets]
+    assert tags == ["warm", "warm", "sched"]  # epoch 10: nobody touches LR
+    np.testing.assert_allclose(sets[2][1], 0.08, rtol=1e-6)
+
+
+def test_broadcast_optimizer_state_numpy_leaves():
+    """numpy leaves (jax.device_get / orbax output) round-trip by value —
+    np.ndarray must not be rebuilt via its shape-constructor."""
+    state = {
+        "v": np.asarray([1.5, 2.5], np.float32),
+        "steps": np.asarray([2, 3], np.int64),
+        "count": np.int64(7),
+    }
+    out = hvd.broadcast_optimizer_state(state)
+    np.testing.assert_allclose(np.asarray(out["v"]), [1.5, 2.5])
+    assert np.asarray(out["steps"]).tolist() == [2, 3]
+    assert int(out["count"]) == 7
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)}, "step": jnp.asarray(3)}
+    base = str(tmp_path / "ckpt")
+    p1 = hvd.save_checkpoint(base, state, step=1)
+    p2 = hvd.save_checkpoint(base, state, step=12)
+    assert p1.endswith("step_1") and p2.endswith("step_12")
+    assert hvd.latest_checkpoint(base).endswith("step_12")
+    restored = hvd.restore_checkpoint(p2)
+    np.testing.assert_allclose(
+        np.asarray(restored["params"]["w"]), np.arange(6.0).reshape(2, 3)
+    )
+
+
+def test_load_model_rewraps_optimizer(tmp_path):
+    """hvd.load_model re-wraps the optimizer so resume keeps distributing
+    (reference keras/__init__.py:115-148)."""
+    state = {"w": jnp.ones(3)}
+    path = hvd.save_checkpoint(str(tmp_path / "m"), state, step=0)
+    restored, tx = hvd.load_model(path, optax.sgd(0.1))
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
+    assert isinstance(tx, optax.GradientTransformation)
+    # wrapped update averages: works inside shard_map
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def step(g):
+        updates, _ = tx.update({"w": g[0]}, tx.init(state), state)
+        return updates["w"]
+
+    f = jax.jit(
+        jax.shard_map(
+            step, mesh=hvd.mesh(), in_specs=P(hvd.AXIS_NAME), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    g = hvd.per_rank(lambda r: jnp.full(3, float(r)))
+    np.testing.assert_allclose(np.asarray(f(g)), -0.1 * 3.5, rtol=1e-6)
