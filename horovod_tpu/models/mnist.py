@@ -1,0 +1,52 @@
+"""MNIST models — the reference's canonical examples
+(reference: examples/tensorflow_mnist.py:37-67 conv net,
+examples/pytorch_mnist.py:60-78 Net, examples/keras_mnist.py:40-52).
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16-friendly,
+static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    """The 2-conv + 2-fc MNIST net every reference frontend trains.
+
+    Mirrors examples/pytorch_mnist.py:60-78 (conv 10/20 5x5, fc 50) in
+    spirit; sizes are rounded to MXU-friendly multiples.
+    """
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class MnistMLP(nn.Module):
+    """Plain MLP variant (keras_mnist.py:40-52 Dense-Dense-Dense)."""
+
+    num_classes: int = 10
+    hidden: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

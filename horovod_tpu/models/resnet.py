@@ -1,0 +1,99 @@
+"""ResNet v1.5 family — the reference's benchmark workhorse
+(reference: examples/pytorch_imagenet_resnet50.py, keras_imagenet_resnet50.py,
+docs/benchmarks.md resnet101 runs; the reference imports torchvision/keras
+model zoos, so this is a from-scratch TPU-first implementation, not a port).
+
+TPU-first choices:
+* NHWC + channels-last conv kernels (XLA TPU native layout; keeps the MXU fed
+  with [spatial, C_in] × [C_in, C_out] contractions).
+* bfloat16 compute / float32 params-and-BN via ``dtype=jnp.bfloat16``.
+* Optional cross-replica BatchNorm: pass ``bn_axis_name="hvd"`` to psum batch
+  statistics over the data axis (the reference trains with per-GPU local BN;
+  syncing is the TPU-era upgrade, off by default for parity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.bn_axis_name,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, H, W, 3] NHWC
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         axis_name=self.bn_axis_name, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    self.width * 2 ** i,
+                    strides=strides,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    """docs/benchmarks.md's tf_cnn_benchmarks resnet101 config."""
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), **kw)

@@ -1,0 +1,41 @@
+"""VGG-16 — the reference's fusion stress benchmark
+(reference: README.md:51-57 cites VGG-16 at 68 % scaling on 512 GPUs — its
+138 M mostly-fc parameters are exactly what Tensor Fusion exists for;
+BASELINE.md config 4 tracks "VGG-16 gradient bucketing → fused psum").
+
+From-scratch NHWC implementation; ``dtype=jnp.bfloat16`` for MXU throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Channel plan per stage, 'M' = maxpool — the classic 16-layer configuration.
+_VGG16_PLAN: Sequence = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                         512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    classifier_width: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for step in _VGG16_PLAN:
+            if step == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(step, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,11 @@
+"""Parallelism strategies: DP (the reference capability), plus TP/SP/ring
+attention as TPU-native extensions (SURVEY.md §2.3 checklist)."""
+
+from horovod_tpu.parallel.attention import (  # noqa: F401
+    blockwise_attention,
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from horovod_tpu.parallel.flash_attention import flash_attention  # noqa: F401
+from horovod_tpu.parallel.mesh import data_parallel_mesh, make_mesh  # noqa: F401

@@ -1,0 +1,235 @@
+"""Attention engines: dense, blockwise (online-softmax), ring, Ulysses.
+
+Long-context sequence parallelism is absent from the reference (SURVEY.md §5
+"Long-context / sequence parallelism: Absent"); the closest primitive is its
+ragged allgather (operations.cc:841-901).  This module supplies the TPU-native
+long-context stack as a first-class capability:
+
+* :func:`dense_attention` — einsum softmax reference implementation.
+* :func:`blockwise_attention` — ``lax.scan`` over KV chunks with the online
+  (flash) softmax recurrence: O(L) memory, differentiable, jit-friendly.
+* :func:`ring_attention` — sequence-parallel attention over a mesh axis:
+  KV blocks rotate around the ring via ``lax.ppermute`` while each shard's
+  queries accumulate, overlap-friendly on ICI (the pattern of Liu et al.'s
+  Ring Attention, built from the same collective the reference's hierarchical
+  allreduce uses for its ring leg).
+* :func:`ulysses_attention` — DeepSpeed-Ulysses-style sequence parallelism:
+  ``all_to_all`` seq→heads, full local attention, ``all_to_all`` back.
+
+All functions take ``[B, L, H, Dh]`` Q and ``[B, L, KVH, Dh]`` K/V (GQA when
+``KVH < H``) and accumulate in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: expand KV heads to match query heads ([B, L, KVH, D] → [B, L, H, D])."""
+    if n_rep == 1:
+        return k
+    b, l, kvh, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, l, kvh, n_rep, d)
+    ).reshape(b, l, kvh * n_rep, d)
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    q_offset: int | jax.Array = 0, kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Reference O(L²)-memory attention (the ground truth for tests).
+
+    ``q_offset``/``kv_offset`` are the global positions of element 0 of the
+    q/kv sequence axes — needed for causal masking on sequence shards.
+    """
+    b, lq, h, d = q.shape
+    kvh = k.shape[2]
+    k = _repeat_kv(k, h // kvh)
+    v = _repeat_kv(v, h // kvh)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(lq)[:, None]
+        kpos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class _SoftmaxState(NamedTuple):
+    """Online-softmax running state (the flash-attention recurrence)."""
+
+    o: jax.Array      # [B, Lq, H, D] f32 unnormalized output accumulator
+    m: jax.Array      # [B, H, Lq]    f32 running row max
+    l: jax.Array      # [B, H, Lq]    f32 running row sum
+
+
+def _init_state(q: jax.Array) -> _SoftmaxState:
+    b, lq, h, d = q.shape
+    return _SoftmaxState(
+        o=jnp.zeros((b, lq, h, d), jnp.float32),
+        m=jnp.full((b, h, lq), NEG_INF, jnp.float32),
+        l=jnp.zeros((b, h, lq), jnp.float32),
+    )
+
+
+def _block_update(
+    state: _SoftmaxState,
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool, q_offset, kv_offset, kv_valid: jax.Array | None = None,
+) -> _SoftmaxState:
+    """Fold one KV block into the running softmax state.
+
+    ``kv_valid``: optional [Lk] bool mask for padded tail keys.
+    """
+    b, lq, h, d = q.shape
+    kvh = k.shape[2]
+    k = _repeat_kv(k, h // kvh)
+    v = _repeat_kv(v, h // kvh)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(lq)[:, None]
+        kpos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if kv_valid is not None:
+        s = jnp.where(kv_valid[None, None, None, :], s, NEG_INF)
+    m_new = jnp.maximum(state.m, s.max(axis=-1))
+    # guard fully-masked rows: keep exp argument finite
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(state.m - m_new)
+    l_new = state.l * correction + p.sum(axis=-1)
+    o_new = (
+        state.o * jnp.transpose(correction, (0, 2, 1))[..., None]
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    )
+    return _SoftmaxState(o_new, m_new, l_new)
+
+
+def _finalize(state: _SoftmaxState, dtype) -> jax.Array:
+    l = jnp.maximum(state.l, 1e-30)
+    return (state.o / jnp.transpose(l, (0, 2, 1))[..., None]).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    block_size: int = 512, q_offset=0, kv_offset=0,
+) -> jax.Array:
+    """O(L)-memory attention: scan over KV chunks with online softmax.
+
+    Single-device analogue of ring attention (one ring step per local KV
+    block); also the differentiable fallback the pallas flash kernel's
+    backward recomputes through.
+    """
+    b, lkv, kvh, d = k.shape
+    nblocks = max(1, math.ceil(lkv / block_size))
+    pad = nblocks * block_size - lkv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(b, nblocks, block_size, kvh, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nblocks, block_size, kvh, d).transpose(1, 0, 2, 3, 4)
+
+    def step(state, inputs):
+        i, kblk, vblk = inputs
+        valid = (i * block_size + jnp.arange(block_size)) < lkv
+        new = _block_update(
+            state, q, kblk, vblk, causal=causal,
+            q_offset=q_offset,
+            kv_offset=kv_offset + i * block_size,
+            kv_valid=valid if pad else None,
+        )
+        return new, None
+
+    idx = jnp.arange(nblocks)
+    state, _ = lax.scan(step, _init_state(q), (idx, kb, vb))
+    return _finalize(state, q.dtype)
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel ring attention over ``axis_name``.
+
+    Call inside ``shard_map`` where the sequence axis is sharded: each rank
+    holds ``[B, L/n, H, D]`` Q/K/V chunks.  KV rotates around the ring
+    (``lax.ppermute``, reference-equivalent of the NCCL ring's neighbor
+    exchange) while local queries fold each visiting block into the online
+    softmax.  n-1 permutes, O(L/n) memory per chip, compute/comm overlap
+    scheduled by XLA.
+
+    Causality across chunks: rank r's queries attend fully to KV chunks from
+    ranks < r, causally to its own, not at all to ranks > r (those blocks
+    are masked by position, costing idle FLOPs on early ranks — the classic
+    ring-attention load skew; zig-zag reordering is a follow-up).
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    lc = q.shape[1]
+    q_offset = rank * lc
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        state, kcur, vcur = carry
+        src_rank = (rank - i) % n  # whose chunk we currently hold
+        state = _block_update(
+            state, q, kcur, vcur, causal=causal,
+            q_offset=q_offset, kv_offset=src_rank * lc,
+        )
+        knext = lax.ppermute(kcur, axis_name, perm)
+        vnext = lax.ppermute(vcur, axis_name, perm)
+        return (state, knext, vnext), None
+
+    # n-1 rotated steps in the scan, last block folded outside it — the
+    # final rotation's result would be discarded, and XLA cannot DCE a
+    # collective inside the scan body (one full KV exchange saved per call).
+    state = _init_state(q)
+    if n > 1:
+        (state, k, v), _ = lax.scan(step, (state, k, v), jnp.arange(n - 1))
+    state = _block_update(
+        state, q, k, v, causal=causal,
+        q_offset=q_offset, kv_offset=((rank - (n - 1)) % n) * lc,
+    )
+    return _finalize(state, q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+    causal: bool = True, impl=None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed Ulysses pattern).
+
+    Inside ``shard_map`` with sequence sharded: all-to-all re-shards from
+    [B, L/n, H, D] (seq-sharded) to [B, L, H/n, D] (head-sharded), runs full
+    attention on the n-th of the heads, and all-to-alls back.  Requires
+    ``H % n == 0`` (and ``KVH % n == 0``); one balanced a2a each way rides
+    ICI's full bisection bandwidth.
+    """
+    n = lax.axis_size(axis_name)
+    h, kvh = q.shape[2], k.shape[2]
+    if h % n or kvh % n:
+        raise ValueError(
+            f"ulysses_attention needs heads divisible by axis size: "
+            f"H={h}, KVH={kvh}, n={n}"
+        )
+    # seq-sharded → head-sharded
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    attend = impl or dense_attention
+    oh = attend(qh, kh, vh, causal=causal)
+    # head-sharded → seq-sharded
+    return lax.all_to_all(oh, axis_name, split_axis=1, concat_axis=2, tiled=True)

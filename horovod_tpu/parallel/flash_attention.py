@@ -1,0 +1,187 @@
+"""Pallas flash-attention kernel (TPU).
+
+The hot op of the flagship transformer, written for the MXU/VMEM model of
+/opt/skills/guides/pallas_guide.md: the KV loop is the innermost grid
+dimension, the online-softmax state (acc / row-max / row-sum) lives in VMEM
+scratch that persists across KV steps, and the normalized output tile is
+written once on the last step.  Causally-masked-out KV blocks are skipped
+with ``pl.when`` (no wasted MXU work past the diagonal).
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes through
+:func:`horovod_tpu.parallel.attention.blockwise_attention` (O(L)-memory
+scan) — flash speed forward, checkpoint-style memory backward, no [L, L]
+materialization anywhere.
+
+On non-TPU backends the kernel runs in interpreter mode so the whole test
+matrix exercises the same code path on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from horovod_tpu.parallel.attention import blockwise_attention
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  seq_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # Skip blocks entirely above the causal diagonal (no MXU work there).
+    @pl.when((not causal) or (k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [bq, D]
+        k = k_ref[0].astype(jnp.float32)            # [bk, D]
+        v = v_ref[0].astype(jnp.float32)            # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [bq, bk]
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_len                        # padded tail keys
+        if causal:
+            mask = mask & (qpos >= kpos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                       # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_ref[:, 0:1] = l_ref[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+        m_ref[:, 0:1] = m_new
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
+                   block_q: int, block_k: int, interpret: bool) -> jax.Array:
+    """q: [B·H, L, D]; k/v: [B·KVH, L, D] — GQA resolved by the KV BlockSpec
+    index map (head ``bh`` reads kv head ``bh%H // (H/KVH)``), so each KV
+    tile is fetched once per group instead of being materialized H/KVH×."""
+    bh, l, d = q.shape
+    n_rep = n_heads // n_kv_heads
+    nq = math.ceil(l / block_q)
+    nk = math.ceil(l / block_k)
+    lq_pad, lk_pad = nq * block_q, nk * block_k
+    if lq_pad != l:
+        q = jnp.pad(q, ((0, 0), (0, lq_pad - l), (0, 0)))
+    if lk_pad != l:
+        k = jnp.pad(k, ((0, 0), (0, lk_pad - l), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, lk_pad - l), (0, 0)))
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=1.0 / math.sqrt(d),
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=l,
+    )
+
+    def kv_index(b, i, j):
+        batch = b // n_heads
+        head = b % n_heads
+        return (batch * n_kv_heads + head // n_rep, j, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :l]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _flash_fwd(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
+    out = _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(n_heads, n_kv_heads, causal, block_q, block_k, res, g):
+    q, k, v = res
+    b = q.shape[0] // n_heads
+    l, d = q.shape[1], q.shape[2]
+
+    def ref(q, k, v):
+        # [B·H, L, D] / [B·KVH, L, D] → blockwise_attention's [B, L, H, D]
+        qb = q.reshape(b, n_heads, l, d).transpose(0, 2, 1, 3)
+        kb = k.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
+        vb = v.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
+        out = blockwise_attention(qb, kb, vb, causal=causal, block_size=block_k)
+        return out.transpose(0, 2, 1, 3).reshape(b * n_heads, l, d)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    block_q: int = 512, block_k: int = 512,
+) -> jax.Array:
+    """Flash attention for [B, L, H, D] q and [B, L, KVH, D] k/v (GQA ok).
+
+    Forward on the MXU via pallas — KV stays at KVH heads, grouped heads
+    share tiles through the BlockSpec index map.  Backward recomputes
+    blockwise (O(L) memory).  Blocks are clamped to the sequence length.
+    """
+    b, l, h, d = q.shape
+    kvh = k.shape[2]
+    block_q = min(block_q, max(l, 1))
+    block_k = min(block_k, max(l, 1))
+    # [B, L, H, D] → [B*H, L, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
+    out = _flash(qt, kt, vt, h, kvh, causal, block_q, block_k)
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,77 @@
+"""Multi-axis device meshes: dp / tp / sp / ep (+ ici/dcn nesting).
+
+The reference's only topology concepts are world/local/cross MPI
+communicators (reference horovod/common/operations.cc:1527-1590).  On TPU
+the topology IS the mesh: this module builds the named meshes every
+parallelism strategy composes over, with the DCN (multi-slice) axis
+outermost so collectives ride ICI within a slice — the mesh-native form of
+the reference's hierarchical allreduce (operations.cc:1070-1223).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    *,
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    pp: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+    dcn_slices: int = 1,
+) -> Mesh:
+    """Build a named mesh ``(['dcn',] 'pp', 'dp', 'ep', 'sp', 'tp')``.
+
+    Axes of size 1 are kept (zero-cost in XLA; specs stay uniform).  ``tp``
+    is innermost so tensor-parallel collectives (the most latency-sensitive)
+    map to nearest-neighbor ICI links; ``dcn_slices`` adds an outermost axis
+    for multi-slice jobs so cross-slice traffic is isolated to DCN.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = [dcn_slices, pp, dp, ep, sp, tp]
+    names = ["dcn", "pp", "dp", "ep", "sp", "tp"]
+    total = int(np.prod(shape))
+    if len(devs) != total:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, shape))} need {total} devices, "
+            f"have {len(devs)}"
+        )
+    if dcn_slices == 1:
+        shape, names = shape[1:], names[1:]
+        # Topology-aware placement: mesh_utils orders devices so the
+        # innermost axes (tp) land on nearest-neighbor ICI links.  Falls
+        # back to list order where topology info is unavailable (CPU mesh).
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(tuple(shape), devices=devs)
+        except Exception:
+            arr = np.asarray(devs).reshape(shape)
+        return Mesh(arr, tuple(names))
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(shape[1:]), dcn_mesh_shape=(dcn_slices,) + (1,) * (len(shape) - 1),
+            devices=devs,
+        ).reshape(shape)
+    except Exception:
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """1-D DP mesh with the Horovod axis name — the same world
+    :func:`horovod_tpu.init` builds (basics.py); reuses AXIS_NAME so
+    shard_map code works against either."""
+    from horovod_tpu.basics import AXIS_NAME
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (AXIS_NAME,))

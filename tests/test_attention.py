@@ -1,0 +1,154 @@
+"""Attention engines: blockwise/ring/ulysses/flash must match dense
+(the long-context stack; no reference equivalent — SURVEY.md §5 notes the
+capability is absent upstream and first-class here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import (
+    blockwise_attention,
+    dense_attention,
+    flash_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def _qkv(b=2, l=32, h=4, kvh=2, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, l, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, l, kvh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, l, kvh, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block", [8, 16, 11])
+def test_blockwise_matches_dense(causal, block):
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, block_size=block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_blockwise_gradient_matches_dense():
+    q, k, v = _qkv(l=16)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    def loss_b(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, causal=True, block_size=8) ** 2)
+
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(loss_b, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    """Sequence-parallel ring over the 8-device mesh == full attention."""
+    q, k, v = _qkv(b=2, l=64, h=4, kvh=4, d=16)
+    ref = dense_attention(q, k, v, causal=causal)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name="hvd", causal=causal),
+            mesh=hvd.mesh(),
+            in_specs=P(None, "hvd"),
+            out_specs=P(None, "hvd"),
+            check_vma=False,
+        )
+    )
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_gradients_flow():
+    """Ring-attention AD: per-rank loss gradients must equal the dense
+    gradients (the canonical pattern — grad locally, average gradients;
+    putting psum inside the loss double-counts under shard_map AD)."""
+    q, k, v = _qkv(b=1, l=32, h=2, kvh=2, d=8)
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, axis_name="hvd", causal=True)
+        return jnp.sum(out ** 2)
+
+    f = jax.jit(
+        jax.shard_map(
+            jax.grad(loss, argnums=(0, 1, 2)),
+            mesh=hvd.mesh(),
+            in_specs=P(None, "hvd"),
+            out_specs=P(None, "hvd"),
+            check_vma=False,
+        )
+    )
+    gq, gk, gv = f(q, k, v)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    dq, dk, dv = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(dq), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(dk), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(dv), atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(causal):
+    q, k, v = _qkv(b=2, l=64, h=8, kvh=8, d=16)
+    ref = dense_attention(q, k, v, causal=causal)
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, axis_name="hvd", causal=causal),
+            mesh=hvd.mesh(),
+            in_specs=P(None, "hvd"),
+            out_specs=P(None, "hvd"),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = _qkv(b=1, l=16, h=4, kvh=4, d=8)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(
+            jax.shard_map(
+                lambda q, k, v: ulysses_attention(q, k, v, axis_name="hvd"),
+                mesh=hvd.mesh(),
+                in_specs=P(None, "hvd"),
+                out_specs=P(None, "hvd"),
+                check_vma=False,
+            )
+        )(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("l", [32, 40])   # 40: exercises tail padding
+def test_flash_matches_dense(causal, l):
+    """Pallas kernel (interpret mode on CPU) == dense reference."""
+    q, k, v = _qkv(b=1, l=l, h=2, kvh=1, d=16)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_gradient_matches_dense():
+    q, k, v = _qkv(b=1, l=24, h=2, kvh=2, d=8)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_k=8) ** 2)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
