@@ -1,0 +1,97 @@
+"""Synthetic ResNet-101 throughput benchmark — images/sec/chip.
+
+TPU-native re-implementation of the reference's benchmark method: the only
+absolute throughput number the reference publishes is tf_cnn_benchmarks
+``--model resnet101 --batch_size 64 --variable_update horovod`` → "total
+images/sec: 1656.82" on 16 Pascal GPUs (/root/reference/docs/benchmarks.md:
+20-38) = 103.55 img/sec/chip.  This harness times the SAME model/batch
+config (ResNet-101, per-chip batch 64, synthetic data, DistributedOptimizer
+gradient averaging) so ``vs_baseline`` is apples-to-apples; the timing loop
+shape (mean over groups of batches) mirrors the in-repo harness
+/root/reference/examples/pytorch_synthetic_benchmark.py:96-110.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # reference docs/benchmarks.md
+
+
+def main() -> None:
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet101
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch_per_chip = int(
+        os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "4")
+    )
+    image_size = int(
+        os.environ.get("HVD_TPU_BENCH_IMG", "224" if on_tpu else "32")
+    )
+    num_iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "10" if on_tpu else "2"))
+    num_batches = int(
+        os.environ.get("HVD_TPU_BENCH_BATCHES", "10" if on_tpu else "2")
+    )
+
+    hvd.init()
+    n = hvd.size()
+    model = ResNet101(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    global_bs = batch_per_chip * n
+    images = jnp.ones((global_bs, image_size, image_size, 3), jnp.float32)
+    labels = jnp.zeros((global_bs,), jnp.int32)
+
+    variables = model.init(jax.random.key(0), images[:1], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Only trainable params are differentiated / allreduced / given momentum;
+    # BN running stats are computed in-forward and discarded (per-chip local
+    # stats, as the reference trains) — a throughput run never reads them.
+    def loss_fn(params, batch):
+        x, y = batch
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(loss_fn, tx)
+
+    out = step(params, opt_state, (images, labels))  # compile + warmup
+    params, opt_state = out.params, out.opt_state
+    jax.block_until_ready(out.loss)
+
+    rates = []
+    for _ in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches):
+            out = step(params, opt_state, (images, labels))
+            params, opt_state = out.params, out.opt_state
+        jax.block_until_ready(out.loss)
+        dt = time.perf_counter() - t0
+        rates.append(global_bs * num_batches / dt)
+
+    total = sum(rates) / len(rates)
+    per_chip = total / n
+    print(json.dumps({
+        "metric": "resnet101_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
