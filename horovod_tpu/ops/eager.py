@@ -92,6 +92,9 @@ class EagerEngine:
         self._dispatch_cache: dict[tuple, Any] = {}
         self._shutdown = threading.Event()
         self._tick = threading.Event()
+        self.controller = self._maybe_native_controller(cfg)
+        self._submitted: dict[str, _PendingOp] = {}
+        self._fuse_group_ids: dict[tuple, int] = {}
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )
@@ -102,6 +105,51 @@ class EagerEngine:
                 target=self._stall_loop, name="horovod_tpu-stall-check", daemon=True
             )
             self._stall_thread.start()
+
+    def _maybe_native_controller(self, cfg):
+        """Bring up the native coordination engine (native/src/controller.cc)
+        when configured.  ``auto`` → multi-controller jobs only (where true
+        negotiation is required for cross-host agreement on op order and
+        fusion — the job the reference's C++ coordinator does,
+        operations.cc:1795-2007); ``on`` forces it (tests / soak);
+        ``off``/unavailable → pure-Python coordination."""
+        mode = (cfg.native_controller or "auto").lower()
+        if mode in ("off", "0", "false", "no"):
+            return None
+        nproc = jax.process_count()
+        if mode == "auto" and nproc == 1:
+            return None
+        from horovod_tpu import native
+
+        if not native.available():
+            if mode != "auto":
+                raise RuntimeError(
+                    "HOROVOD_TPU_NATIVE_CONTROLLER=on but libhvdtpu.so "
+                    "could not be built/loaded"
+                )
+            return None
+        spec = cfg.controller_transport
+        if spec is None:
+            if nproc > 1:
+                if mode != "auto":
+                    raise RuntimeError(
+                        "HOROVOD_TPU_NATIVE_CONTROLLER=on on a multi-host "
+                        "job requires HOROVOD_TPU_CONTROLLER_TRANSPORT "
+                        "(e.g. tcp:<rank0-host>:<port>)"
+                    )
+                # auto multi-host with no transport configured: fall back to
+                # Python coordination (caller-delimited fusion groups only).
+                return None
+            import os as _os
+
+            spec = f"local:engine-{_os.getpid()}"
+        return native.NativeController(
+            rank=jax.process_index(),
+            size=nproc,
+            transport_spec=spec,
+            fusion_threshold_bytes=cfg.fusion_threshold_bytes,
+            stall_warning_s=cfg.stall_warning_time_s,
+        )
 
     # ------------------------------------------------------------------ queue
 
@@ -142,15 +190,22 @@ class EagerEngine:
     def flush(self) -> None:
         """Drain the queue now: group, fuse, dispatch.
 
-        The analogue of one ``RunLoopOnce`` tick (operations.cc:1795-2007)
-        minus the MPI negotiation (see module docstring).  Serialized under
-        ``_flush_lock`` so concurrent callers (cycle thread, poll,
-        synchronize) cannot interleave dispatch order."""
+        The analogue of one ``RunLoopOnce`` tick (operations.cc:1795-2007).
+        With the native controller, requests are negotiated (gather → match
+        → fuse → bcast, native/src/controller.cc) and dispatch follows the
+        returned batch order; without it, negotiation is a no-op under the
+        single controller (see module docstring) and fusion is planned
+        locally.  Serialized under ``_flush_lock`` so concurrent callers
+        (cycle thread, poll, synchronize) cannot interleave dispatch order.
+        """
         from horovod_tpu.ops import fusion
 
         with self._flush_lock:
             with self._lock:
                 batch, self._queue = self._queue, []
+            if self.controller is not None:
+                self._flush_via_controller(batch)
+                return
             if not batch:
                 return
             for p in batch:
@@ -171,6 +226,100 @@ class EagerEngine:
                 else:
                     assert len(group) == 1
                     self._dispatch_single(group[0])
+
+    _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "sparse": 3}
+
+    def _controller_group(self, p: _PendingOp) -> int:
+        """Encode fusability (reduce op, compression) into the controller's
+        int64 ``group`` so negotiation never merges requests that need
+        different compiled programs.  Caller-delimited group ids are NOT
+        part of the key: with true negotiation the batch order is globally
+        agreed, so cross-group merging is safe — and keying on per-call ids
+        would grow this cache by one entry per training step."""
+        if p.kind != "allreduce":
+            return -1
+        key = (p.op.name, p.compression)
+        gid = self._fuse_group_ids.get(key)
+        if gid is None:
+            gid = len(self._fuse_group_ids)
+            self._fuse_group_ids[key] = gid
+        return gid
+
+    def _flush_via_controller(self, batch: list[_PendingOp]) -> None:
+        """Submit new requests, run one negotiation tick, dispatch the
+        globally-agreed batches (names → this process's pending ops)."""
+        for p in batch:
+            if p.name in self._submitted:
+                # The reference rejects duplicate in-flight names at enqueue
+                # (operations.cc:2124-2134).
+                self._end_negotiate(p)
+                self.handles.mark_error(
+                    p.handle,
+                    RuntimeError(f"Duplicate tensor name in flight: {p.name}"),
+                )
+                continue
+            try:
+                self.controller.submit(
+                    self._KIND_CODES[p.kind],
+                    str(p.tensor.dtype),
+                    p.name,
+                    tuple(p.tensor.shape[1:]),
+                    root_rank=p.root_rank,
+                    group=self._controller_group(p),
+                )
+            except Exception as e:
+                # Per-op containment, like the non-controller dispatch path:
+                # a rejected request fails ITS handle, not the whole flush.
+                self._end_negotiate(p)
+                self.handles.mark_error(p.handle, e)
+                continue
+            self._submitted[p.name] = p
+        try:
+            bl = self.controller.tick()
+        except Exception as e:
+            # A broken control plane strands every outstanding op; fail
+            # their handles so waiters unblock instead of hanging.
+            for p in self._submitted.values():
+                self._end_negotiate(p)
+                self.handles.mark_error(p.handle, e)
+            self._submitted.clear()
+            raise
+        for b in bl.batches:
+            ops = [
+                self._submitted.pop(n) for n in b.names if n in self._submitted
+            ]
+            if not ops:
+                continue
+            for p in ops:
+                self._end_negotiate(p)
+            if b.error:
+                err = RuntimeError(b.error)
+                for p in ops:
+                    self.handles.mark_error(p.handle, err)
+            elif ops[0].kind == "allreduce":
+                self._dispatch_allreduce_group(ops)
+            else:
+                for p in ops:
+                    self._dispatch_single(p)
+        if bl.shutdown:
+            # Orphaned ops (submitted but never matched before the shutdown
+            # response) must error, not hang their waiters — parity with the
+            # reference's SHUT_DOWN_ERROR callbacks (operations.cc:278-283).
+            err = RuntimeError(
+                "horovod_tpu has been shut down; collective was not "
+                "completed by all ranks"
+            )
+            for p in self._submitted.values():
+                self._end_negotiate(p)
+                self.handles.mark_error(p.handle, err)
+            self._submitted.clear()
+            self._shutdown.set()
+
+    def _end_negotiate(self, p: _PendingOp) -> None:
+        if self.timeline:
+            self.timeline.end(
+                p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
+            )
 
     def _cycle_loop(self) -> None:
         """Background tick every ``HOROVOD_CYCLE_TIME`` ms
@@ -199,6 +348,12 @@ class EagerEngine:
                 stalled = [
                     p.name for p in self._queue if now - p.enqueued_at > warn_after
                 ]
+            if self.controller is not None:
+                # Rank-0's native table knows which ranks are missing
+                # (reference stall message lists them, operations.cc:1455).
+                report = self.controller.stall_report()
+                if report:
+                    stalled.append(report)
             if stalled:
                 print(
                     "WARNING: One or more tensors were submitted to be "
@@ -209,10 +364,16 @@ class EagerEngine:
                 )
 
     def shutdown(self) -> None:
-        """Coordinated shutdown: flush outstanding work, stop threads
+        """Coordinated shutdown: flush outstanding work, propagate the
+        shutdown through the control plane, stop threads
         (reference operations.cc:1699-1729)."""
         try:
             self.flush()
+            if self.controller is not None:
+                # One more negotiated tick so every rank sees the shutdown
+                # response (reference :1881-1884, 1906).
+                self.controller.request_shutdown()
+                self.flush()
         finally:
             self._shutdown.set()
             self._tick.set()
@@ -220,6 +381,8 @@ class EagerEngine:
                 self._cycle_thread.join(timeout=5)
             if self._stall_thread is not None and self._stall_thread.is_alive():
                 self._stall_thread.join(timeout=5)
+            if self.controller is not None:
+                self.controller.close()
 
     # --------------------------------------------------------------- dispatch
 

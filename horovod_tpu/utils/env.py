@@ -69,6 +69,13 @@ class EngineConfig:
     stall_warning_time_s: float = DEFAULT_STALL_WARNING_TIME_S
     hierarchical_allreduce: bool = False
     sparse_allreduce: bool = False
+    # Native coordination engine (native/src/): "auto" enables it for
+    # multi-controller jobs when libhvdtpu builds; "on" forces it (tests,
+    # single-host soak); "off" keeps pure-Python coordination.
+    native_controller: str = "auto"
+    # Transport spec for the native control plane: "tcp:<host>:<port>"
+    # (multi-host; rank 0 binds) or "local:<world>" (in-process).
+    controller_transport: str | None = None
 
     @classmethod
     def from_env(cls) -> "EngineConfig":
@@ -84,4 +91,10 @@ class EngineConfig:
             ),
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             sparse_allreduce=_get_bool(HOROVOD_SPARSE_ALLREDUCE),
+            native_controller=os.environ.get(
+                "HOROVOD_TPU_NATIVE_CONTROLLER", "auto"
+            ).strip().lower(),
+            controller_transport=os.environ.get(
+                "HOROVOD_TPU_CONTROLLER_TRANSPORT"
+            ) or None,
         )

@@ -1,0 +1,104 @@
+// C API for the coordination controller, consumed by Python via ctypes.
+//
+// The reference binds its engine through a per-framework compiled
+// extension (reference: horovod/common/operations.cc:2040-2095 C API +
+// horovod/common/__init__.py ctypes loader).  Here one flat C surface
+// serves every frontend; batch lists travel back as wire-format bytes the
+// Python side parses (no per-dtype symbol explosion).
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "controller.h"
+#include "wire.h"
+
+using hvdtpu::BatchList;
+using hvdtpu::Controller;
+using hvdtpu::DType;
+using hvdtpu::OpKind;
+using hvdtpu::Request;
+
+namespace {
+
+void FillError(char* err_buf, int err_len, const std::string& msg) {
+  if (err_buf && err_len > 0) {
+    std::snprintf(err_buf, static_cast<size_t>(err_len), "%s", msg.c_str());
+  }
+}
+
+uint8_t* CopyOut(const std::string& s, uint64_t* out_len) {
+  auto* p = static_cast<uint8_t*>(std::malloc(s.size() ? s.size() : 1));
+  std::memcpy(p, s.data(), s.size());
+  *out_len = s.size();
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hvdtpu_controller_create(int rank, int size, const char* transport_spec,
+                               long long fusion_threshold_bytes,
+                               double stall_warning_s, char* err_buf,
+                               int err_len) {
+  std::string error;
+  auto transport =
+      hvdtpu::MakeTransport(transport_spec ? transport_spec : "", rank, size,
+                            &error);
+  if (!transport) {
+    FillError(err_buf, err_len, error);
+    return nullptr;
+  }
+  return new Controller(rank, size, std::move(transport),
+                        fusion_threshold_bytes, stall_warning_s);
+}
+
+void hvdtpu_controller_destroy(void* ctrl) {
+  delete static_cast<Controller*>(ctrl);
+}
+
+int hvdtpu_controller_submit(void* ctrl, unsigned char kind,
+                             unsigned char dtype, const char* name,
+                             const long long* shape, int ndim, int root_rank,
+                             long long group) {
+  if (!ctrl || !name || kind > 3 || dtype > 12) return -1;
+  Request r;
+  r.kind = static_cast<OpKind>(kind);
+  r.dtype = static_cast<DType>(dtype);
+  r.name = name;
+  r.root_rank = root_rank;
+  r.group = group;
+  r.shape.assign(shape, shape + ndim);
+  static_cast<Controller*>(ctrl)->Submit(std::move(r));
+  return 0;
+}
+
+void hvdtpu_controller_request_shutdown(void* ctrl) {
+  static_cast<Controller*>(ctrl)->RequestShutdown();
+}
+
+// Returns 0 on a live tick, 1 once shutdown has propagated, -1 on
+// transport failure.  *out/*out_len receive wire-format BatchList bytes;
+// free with hvdtpu_free.
+int hvdtpu_controller_tick(void* ctrl, uint8_t** out, uint64_t* out_len) {
+  BatchList bl;
+  bool live;
+  try {
+    live = static_cast<Controller*>(ctrl)->Tick(&bl);
+  } catch (const std::exception&) {
+    return -1;
+  }
+  *out = CopyOut(hvdtpu::wire::SerializeBatchList(bl), out_len);
+  return live ? 0 : 1;
+}
+
+int hvdtpu_controller_stall_report(void* ctrl, uint8_t** out,
+                                   uint64_t* out_len) {
+  *out = CopyOut(static_cast<Controller*>(ctrl)->StallReport(), out_len);
+  return 0;
+}
+
+void hvdtpu_free(uint8_t* p) { std::free(p); }
+
+}  // extern "C"

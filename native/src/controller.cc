@@ -1,0 +1,221 @@
+#include "controller.h"
+
+#include <chrono>
+#include <sstream>
+
+#include "wire.h"
+
+namespace hvdtpu {
+namespace {
+
+double NowS() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string ShapeStr(const std::vector<int64_t>& s) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < s.size(); ++i) os << (i ? ", " : "") << s[i];
+  os << "]";
+  return os.str();
+}
+
+const char* KindName(OpKind k) {
+  switch (k) {
+    case OpKind::kAllreduce:
+      return "allreduce";
+    case OpKind::kAllgather:
+      return "allgather";
+    case OpKind::kBroadcast:
+      return "broadcast";
+    case OpKind::kSparse:
+      return "sparse_allreduce";
+  }
+  return "?";
+}
+
+}  // namespace
+
+Controller::Controller(int rank, int size,
+                       std::unique_ptr<Transport> transport,
+                       int64_t fusion_threshold_bytes, double stall_warning_s)
+    : rank_(rank),
+      size_(size),
+      fusion_threshold_bytes_(fusion_threshold_bytes),
+      stall_warning_s_(stall_warning_s),
+      transport_(std::move(transport)) {}
+
+void Controller::Submit(Request r) {
+  r.rank = rank_;
+  std::lock_guard<std::mutex> lk(pending_mu_);
+  pending_.push_back(std::move(r));
+}
+
+void Controller::RequestShutdown() {
+  std::lock_guard<std::mutex> lk(pending_mu_);
+  shutdown_requested_ = true;
+}
+
+void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
+  auto it = table_.find(r.name);
+  if (it == table_.end()) {
+    TableEntry e;
+    e.first = r;
+    e.seen.assign(size_, false);
+    e.first_seen_s = NowS();
+    it = table_.emplace(r.name, std::move(e)).first;
+  }
+  TableEntry& e = it->second;
+  if (e.seen[r.rank]) {
+    // Same name enqueued twice before completion — the reference treats
+    // duplicate in-flight names as a usage error (operations.cc:2124-2134).
+    // Do NOT bump the count: it must keep meaning "distinct ranks seen",
+    // or a double submission could release a batch with ranks missing.
+    e.error = "Duplicate tensor name in flight: " + r.name;
+  } else {
+    e.seen[r.rank] = true;
+    ++e.count;
+  }
+
+  // Consistency validation against the first-seen request — the analogue
+  // of ConstructMPIResponse's checks (operations.cc:335-537).
+  const Request& f = e.first;
+  if (e.error.empty() && r.kind != f.kind) {
+    e.error = std::string("Mismatched collective kinds for tensor ") + r.name +
+              ": " + KindName(f.kind) + " vs " + KindName(r.kind);
+  }
+  if (e.error.empty() && r.dtype != f.dtype) {
+    e.error = "Mismatched tensor dtypes for " + r.name;
+  }
+  if (e.error.empty()) {
+    switch (r.kind) {
+      case OpKind::kAllreduce:
+      case OpKind::kSparse:
+        if (r.shape != f.shape)
+          e.error = "Mismatched allreduce tensor shapes for " + r.name + ": " +
+                    ShapeStr(f.shape) + " vs " + ShapeStr(r.shape);
+        break;
+      case OpKind::kAllgather:
+        // First dim may differ per rank (ragged gather); trailing dims must
+        // agree (reference operations.cc:841-901).
+        if (r.shape.size() != f.shape.size() ||
+            (r.shape.size() > 1 &&
+             !std::equal(r.shape.begin() + 1, r.shape.end(),
+                         f.shape.begin() + 1)))
+          e.error = "Mismatched allgather trailing dims for " + r.name + ": " +
+                    ShapeStr(f.shape) + " vs " + ShapeStr(r.shape);
+        break;
+      case OpKind::kBroadcast:
+        if (r.root_rank != f.root_rank)
+          e.error = "Mismatched broadcast root_rank for " + r.name;
+        else if (r.shape != f.shape)
+          e.error = "Mismatched broadcast tensor shapes for " + r.name;
+        break;
+    }
+  }
+  if (e.count == size_) ready->push_back(r.name);
+}
+
+BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
+  BatchList bl;
+  Batch cur;
+  int64_t cur_bytes = 0;
+  DType cur_dtype = DType::kF32;
+  int64_t cur_group = -1;
+  auto flush = [&] {
+    if (!cur.names.empty()) bl.batches.push_back(std::move(cur));
+    cur = Batch();
+    cur_bytes = 0;
+  };
+  for (const std::string& name : ready) {
+    auto it = table_.find(name);
+    TableEntry& e = it->second;
+    const bool fusable = e.error.empty() && e.first.kind == OpKind::kAllreduce;
+    const int64_t bytes = e.first.PayloadBytes();
+    if (!fusable) {
+      flush();
+      Batch b;
+      b.kind = e.first.kind;
+      b.error = e.error;
+      b.names.push_back(name);
+      bl.batches.push_back(std::move(b));
+    } else {
+      // Merge consecutive ready allreduces of one dtype and fusion group up
+      // to the threshold (reference response merging, operations.cc:
+      // 1916-1943).  `group` encodes caller-side fusability (reduce op,
+      // compression) so the controller never merges incompatible programs.
+      const bool same = !cur.names.empty() && cur_dtype == e.first.dtype &&
+                        cur_group == e.first.group;
+      if (!same || cur_bytes + bytes > fusion_threshold_bytes_) flush();
+      cur.kind = OpKind::kAllreduce;
+      cur_dtype = e.first.dtype;
+      cur_group = e.first.group;
+      cur.names.push_back(name);
+      cur_bytes += bytes;
+    }
+    table_.erase(it);
+  }
+  flush();
+  return bl;
+}
+
+bool Controller::Tick(BatchList* out) {
+  if (shut_down_) {
+    out->shutdown = true;
+    return false;
+  }
+  RequestList mine;
+  {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    mine.requests.swap(pending_);
+    mine.shutdown = shutdown_requested_;
+  }
+  std::vector<std::string> gathered;
+  if (!transport_->GatherToRoot(wire::SerializeRequestList(mine), &gathered))
+    return false;
+
+  std::string response_bytes;
+  if (rank_ == 0) {
+    bool shutdown_seen = false;
+    std::vector<std::string> ready;
+    std::lock_guard<std::mutex> lk(table_mu_);
+    for (const std::string& payload : gathered) {
+      wire::Reader rd(payload);
+      RequestList rl = wire::ParseRequestList(rd);
+      if (rl.shutdown) shutdown_seen = true;
+      for (const Request& r : rl.requests) Ingest(r, &ready);
+    }
+    BatchList built = BuildBatches(ready);
+    built.shutdown = shutdown_seen;
+    response_bytes = wire::SerializeBatchList(built);
+  }
+  std::string received;
+  if (!transport_->BcastFromRoot(response_bytes, &received)) return false;
+  wire::Reader rd(received);
+  *out = wire::ParseBatchList(rd);
+  if (out->shutdown) shut_down_ = true;
+  return !out->shutdown;
+}
+
+std::string Controller::StallReport() {
+  if (rank_ != 0) return "";
+  const double now = NowS();
+  std::ostringstream os;
+  bool any = false;
+  std::lock_guard<std::mutex> lk(table_mu_);
+  for (const auto& kv : table_) {
+    const TableEntry& e = kv.second;
+    if (now - e.first_seen_s < stall_warning_s_) continue;
+    if (any) os << "; ";
+    any = true;
+    os << kv.first << " (missing ranks:";
+    for (int r = 0; r < size_; ++r)
+      if (!e.seen[r]) os << " " << r;
+    os << ")";
+  }
+  return os.str();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,89 @@
+// The coordination controller: named-tensor readiness negotiation.
+//
+// TPU-native re-design of the reference's coordinator protocol
+// (reference: horovod/common/operations.cc — RunLoopOnce :1795-2007,
+// IncrementTensorCount :302-327, ConstructMPIResponse :335-537, response
+// fusion :1916-1943, stall check :1424-1470).  Frameworks enqueue named
+// collectives in nondeterministic order per rank; the controller's job is
+// global agreement on WHICH tensors run, in WHAT order, fused HOW.  Rank 0
+// gathers every rank's request list each tick, matches readiness (a tensor
+// is ready when all `size` ranks have requested it), validates consistency
+// (kind/dtype/shape/root), fuses consecutive ready allreduces of one dtype
+// under the fusion threshold, and broadcasts the resulting batch list.
+// Every rank then dispatches identical batches in identical order — which
+// is what lets the Python layer launch one compiled XLA collective per
+// batch without SPMD-order guarantees from the frontend.
+//
+// The data plane never touches this code: batches carry tensor *names*;
+// payloads stay in device HBM and move over ICI via XLA collectives.
+
+#ifndef HVDTPU_CONTROLLER_H_
+#define HVDTPU_CONTROLLER_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "transport.h"
+#include "types.h"
+
+namespace hvdtpu {
+
+class Controller {
+ public:
+  Controller(int rank, int size, std::unique_ptr<Transport> transport,
+             int64_t fusion_threshold_bytes, double stall_warning_s);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Enqueue a request from the frontend thread (thread-safe).
+  void Submit(Request r);
+
+  // Flag this rank's clean exit; propagated to all ranks on the next tick
+  // (reference shutdown propagation, operations.cc:1699-1729).
+  void RequestShutdown();
+
+  // Run one negotiation round: gather -> match -> fuse -> bcast.
+  // Returns false once a shutdown response has been observed (sticky).
+  bool Tick(BatchList* out);
+
+  // Rank-0 stall summary: tensors requested by a subset of ranks for longer
+  // than the warning threshold, with the missing ranks (empty if none).
+  std::string StallReport();
+
+ private:
+  struct TableEntry {
+    Request first;            // first-seen copy, the validation reference
+    std::vector<bool> seen;   // which ranks have requested it
+    int count = 0;
+    std::string error;        // sticky validation error
+    double first_seen_s = 0;  // monotonic arrival time of first request
+  };
+
+  void Ingest(const Request& r, std::vector<std::string>* ready);
+  BatchList BuildBatches(const std::vector<std::string>& ready);
+
+  const int rank_, size_;
+  const int64_t fusion_threshold_bytes_;
+  const double stall_warning_s_;
+  std::unique_ptr<Transport> transport_;
+
+  std::mutex pending_mu_;
+  std::vector<Request> pending_;
+  bool shutdown_requested_ = false;
+  bool shut_down_ = false;
+
+  // Rank-0 only: the message table (reference operations.cc:1688-1690).
+  // Guarded by table_mu_: Tick mutates it on the cycle thread while
+  // StallReport reads it from the stall-watchdog thread.
+  std::mutex table_mu_;
+  std::map<std::string, TableEntry> table_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_CONTROLLER_H_

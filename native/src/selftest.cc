@@ -1,0 +1,182 @@
+// C++ self-test for the coordination controller: N rank threads negotiate
+// over LocalTransport and must all observe identical fused batch order —
+// the property the reference gets from its MPI coordinator protocol
+// (reference: horovod/common/operations.cc:1795-2007).  Run via
+// `make -C native test`; the pytest suite drives the same scenarios
+// through the C API (tests/test_native_controller.py).
+
+#include <algorithm>
+#include <cassert>
+#include <cstdio>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+
+using namespace hvdtpu;
+
+namespace {
+
+std::unique_ptr<Controller> MakeLocal(const std::string& world, int rank,
+                                      int size, int64_t threshold) {
+  std::string err;
+  auto t = MakeTransport("local:" + world, rank, size, &err);
+  assert(t && "transport create failed");
+  return std::make_unique<Controller>(rank, size, std::move(t), threshold,
+                                      60.0);
+}
+
+Request AR(const std::string& name, std::vector<int64_t> shape,
+           DType dt = DType::kF32) {
+  Request r;
+  r.kind = OpKind::kAllreduce;
+  r.dtype = dt;
+  r.name = name;
+  r.shape = std::move(shape);
+  return r;
+}
+
+// Ranks submit the same three tensors in different orders; all must agree
+// on one fused batch order.
+void TestAgreementAndFusion() {
+  const int kSize = 4;
+  std::vector<BatchList> results(kSize);
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank, &results] {
+      auto c = MakeLocal("agree", rank, kSize, 1 << 20);
+      // Different per-rank submission order (nondeterministic frameworks).
+      std::vector<Request> reqs = {AR("a", {8}), AR("b", {4}), AR("c", {2})};
+      std::rotate(reqs.begin(), reqs.begin() + rank % 3, reqs.end());
+      for (auto& r : reqs) c->Submit(r);
+      BatchList bl;
+      while (results[rank].batches.empty()) {
+        bool live = c->Tick(&bl);
+        assert(live);
+        for (auto& b : bl.batches) results[rank].batches.push_back(b);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  assert(results[0].batches.size() == 1);  // all fused: same dtype, tiny
+  assert(results[0].batches[0].names.size() == 3);
+  for (int r = 1; r < kSize; ++r) {
+    assert(results[r].batches.size() == results[0].batches.size());
+    assert(results[r].batches[0].names == results[0].batches[0].names);
+  }
+  std::printf("agreement+fusion ok\n");
+}
+
+// Fusion threshold: 3 tensors of 400 bytes with a 800-byte threshold must
+// split into two batches.
+void TestThresholdSplit() {
+  const int kSize = 2;
+  std::vector<BatchList> results(kSize);
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank, &results] {
+      auto c = MakeLocal("split", rank, kSize, 800);
+      for (auto* n : {"x", "y", "z"}) c->Submit(AR(n, {100}));  // 400 B each
+      BatchList bl;
+      size_t total = 0;
+      while (total < 3) {
+        assert(c->Tick(&bl));
+        for (auto& b : bl.batches) {
+          total += b.names.size();
+          results[rank].batches.push_back(b);
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  assert(results[0].batches.size() == 2);
+  assert(results[0].batches[0].names.size() == 2);
+  assert(results[0].batches[1].names.size() == 1);
+  assert(results[1].batches[0].names == results[0].batches[0].names);
+  std::printf("threshold split ok\n");
+}
+
+// Mismatched shapes across ranks must produce an error batch on all ranks.
+void TestShapeMismatch() {
+  const int kSize = 2;
+  std::vector<BatchList> results(kSize);
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank, &results] {
+      auto c = MakeLocal("mismatch", rank, kSize, 1 << 20);
+      c->Submit(AR("bad", {rank ? 4 : 8}));  // even vs odd shapes
+      BatchList bl;
+      while (results[rank].batches.empty()) {
+        assert(c->Tick(&bl));
+        for (auto& b : bl.batches) results[rank].batches.push_back(b);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  for (int r = 0; r < kSize; ++r) {
+    assert(results[r].batches.size() == 1);
+    assert(!results[r].batches[0].error.empty());
+  }
+  std::printf("shape mismatch ok: %s\n", results[0].batches[0].error.c_str());
+}
+
+// Shutdown from one rank propagates to all.
+void TestShutdown() {
+  const int kSize = 3;
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank] {
+      auto c = MakeLocal("shutdown", rank, kSize, 1 << 20);
+      if (rank == 1) c->RequestShutdown();
+      BatchList bl;
+      bool live = c->Tick(&bl);
+      assert(!live && bl.shutdown);
+    });
+  }
+  for (auto& t : threads) t.join();
+  std::printf("shutdown propagation ok\n");
+}
+
+// TCP transport: same agreement property over real sockets.
+void TestTcp() {
+  const int kSize = 2;
+  std::vector<BatchList> results(kSize);
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank, &results] {
+      std::string err;
+      auto t = MakeTransport("tcp:127.0.0.1:19755", rank, kSize, &err);
+      assert(t && "tcp transport failed");
+      Controller c(rank, kSize, std::move(t), 1 << 20, 60.0);
+      c.Submit(AR(rank ? "t2" : "t1", {4}));
+      c.Submit(AR(rank ? "t1" : "t2", {4}));
+      BatchList bl;
+      size_t total = 0;
+      while (total < 2) {
+        assert(c.Tick(&bl));
+        for (auto& b : bl.batches) {
+          total += b.names.size();
+          results[rank].batches.push_back(b);
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  assert(results[0].batches.size() == results[1].batches.size());
+  for (size_t i = 0; i < results[0].batches.size(); ++i)
+    assert(results[0].batches[i].names == results[1].batches[i].names);
+  std::printf("tcp transport ok\n");
+}
+
+}  // namespace
+
+int main() {
+  TestAgreementAndFusion();
+  TestThresholdSplit();
+  TestShapeMismatch();
+  TestShutdown();
+  TestTcp();
+  std::printf("all native self-tests passed\n");
+  return 0;
+}

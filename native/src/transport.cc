@@ -1,0 +1,256 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+
+namespace hvdtpu {
+namespace {
+
+// ---------------------------------------------------------------- local
+
+// Shared rendezvous state for one in-process world.  The gather/bcast
+// protocol is generation-free: a rank may not contribute twice to the same
+// gather round (it blocks until root resets), and the bcast that ends every
+// tick is the barrier that keeps rounds aligned.
+struct LocalWorld {
+  std::mutex mu;
+  std::condition_variable cv;
+  int size = 0;
+  std::vector<std::string> slots;
+  std::vector<bool> contributed;
+  int n_contributed = 0;
+  std::string bcast_payload;
+  uint64_t bcast_gen = 0;
+};
+
+std::mutex g_worlds_mu;
+std::map<std::string, std::shared_ptr<LocalWorld>> g_worlds;
+
+std::shared_ptr<LocalWorld> GetWorld(const std::string& name, int size) {
+  std::lock_guard<std::mutex> lk(g_worlds_mu);
+  auto it = g_worlds.find(name);
+  if (it != g_worlds.end()) return it->second;
+  auto w = std::make_shared<LocalWorld>();
+  w->size = size;
+  w->slots.resize(size);
+  w->contributed.assign(size, false);
+  g_worlds[name] = w;
+  return w;
+}
+
+class LocalTransport : public Transport {
+ public:
+  LocalTransport(std::shared_ptr<LocalWorld> w, int rank)
+      : world_(std::move(w)), rank_(rank) {}
+
+  bool GatherToRoot(const std::string& payload,
+                    std::vector<std::string>* out) override {
+    std::unique_lock<std::mutex> lk(world_->mu);
+    world_->cv.wait(lk, [&] { return !world_->contributed[rank_]; });
+    world_->contributed[rank_] = true;
+    world_->slots[rank_] = payload;
+    ++world_->n_contributed;
+    world_->cv.notify_all();
+    if (rank_ == 0) {
+      world_->cv.wait(lk, [&] { return world_->n_contributed == world_->size; });
+      *out = world_->slots;
+      std::fill(world_->contributed.begin(), world_->contributed.end(), false);
+      world_->n_contributed = 0;
+      world_->cv.notify_all();
+    }
+    return true;
+  }
+
+  bool BcastFromRoot(const std::string& payload, std::string* out) override {
+    std::unique_lock<std::mutex> lk(world_->mu);
+    if (rank_ == 0) {
+      world_->bcast_payload = payload;
+      ++world_->bcast_gen;
+      *out = payload;
+      world_->cv.notify_all();
+    } else {
+      uint64_t target = seen_gen_ + 1;
+      world_->cv.wait(lk, [&] { return world_->bcast_gen >= target; });
+      seen_gen_ = target;
+      *out = world_->bcast_payload;
+    }
+    return true;
+  }
+
+ private:
+  std::shared_ptr<LocalWorld> world_;
+  int rank_;
+  uint64_t seen_gen_ = 0;
+};
+
+// ------------------------------------------------------------------ tcp
+
+bool SendAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool SendFrame(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  return SendAll(fd, &len, 4) && SendAll(fd, payload.data(), payload.size());
+}
+
+bool RecvFrame(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!RecvAll(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || RecvAll(fd, &(*out)[0], len);
+}
+
+class TcpTransport : public Transport {
+ public:
+  ~TcpTransport() override {
+    for (int fd : worker_fds_)
+      if (fd >= 0) ::close(fd);
+    if (conn_fd_ >= 0) ::close(conn_fd_);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  bool Init(const std::string& host, int port, int rank, int size,
+            std::string* error) {
+    rank_ = rank;
+    size_ = size;
+    if (rank == 0) {
+      listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (listen_fd_ < 0) return Fail(error, "socket() failed");
+      int one = 1;
+      ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      addr.sin_addr.s_addr = INADDR_ANY;
+      if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)))
+        return Fail(error, "bind() failed on port " + std::to_string(port));
+      if (::listen(listen_fd_, size)) return Fail(error, "listen() failed");
+      worker_fds_.assign(size, -1);
+      for (int i = 0; i < size - 1; ++i) {
+        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        if (fd < 0) return Fail(error, "accept() failed");
+        int nd = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+        uint32_t peer_rank = 0;
+        if (!RecvAll(fd, &peer_rank, 4) || peer_rank >= (uint32_t)size)
+          return Fail(error, "bad hello from worker");
+        worker_fds_[peer_rank] = fd;
+      }
+    } else {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      std::string port_s = std::to_string(port);
+      if (::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res))
+        return Fail(error, "getaddrinfo(" + host + ") failed");
+      // Retry connect for up to ~60 s: workers may start before rank 0
+      // binds (the reference leans on mpirun for this ordering).
+      for (int attempt = 0; attempt < 600; ++attempt) {
+        conn_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (conn_fd_ >= 0 &&
+            ::connect(conn_fd_, res->ai_addr, res->ai_addrlen) == 0)
+          break;
+        if (conn_fd_ >= 0) ::close(conn_fd_);
+        conn_fd_ = -1;
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+      ::freeaddrinfo(res);
+      if (conn_fd_ < 0)
+        return Fail(error, "could not connect to coordinator " + host);
+      int nd = 1;
+      ::setsockopt(conn_fd_, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+      uint32_t r = static_cast<uint32_t>(rank);
+      if (!SendAll(conn_fd_, &r, 4)) return Fail(error, "hello send failed");
+    }
+    return true;
+  }
+
+  bool GatherToRoot(const std::string& payload,
+                    std::vector<std::string>* out) override {
+    if (rank_ == 0) {
+      out->assign(size_, std::string());
+      (*out)[0] = payload;
+      for (int r = 1; r < size_; ++r)
+        if (!RecvFrame(worker_fds_[r], &(*out)[r])) return false;
+      return true;
+    }
+    return SendFrame(conn_fd_, payload);
+  }
+
+  bool BcastFromRoot(const std::string& payload, std::string* out) override {
+    if (rank_ == 0) {
+      for (int r = 1; r < size_; ++r)
+        if (!SendFrame(worker_fds_[r], payload)) return false;
+      *out = payload;
+      return true;
+    }
+    return RecvFrame(conn_fd_, out);
+  }
+
+ private:
+  static bool Fail(std::string* error, const std::string& msg) {
+    if (error) *error = msg;
+    return false;
+  }
+
+  int rank_ = 0, size_ = 0;
+  int listen_fd_ = -1, conn_fd_ = -1;
+  std::vector<int> worker_fds_;
+};
+
+}  // namespace
+
+std::unique_ptr<Transport> MakeTransport(const std::string& spec, int rank,
+                                         int size, std::string* error) {
+  if (spec.rfind("local:", 0) == 0) {
+    return std::make_unique<LocalTransport>(GetWorld(spec.substr(6), size),
+                                            rank);
+  }
+  if (spec.rfind("tcp:", 0) == 0) {
+    std::string rest = spec.substr(4);
+    size_t colon = rest.rfind(':');
+    if (colon == std::string::npos) {
+      if (error) *error = "tcp spec must be tcp:<host>:<port>";
+      return nullptr;
+    }
+    auto t = std::make_unique<TcpTransport>();
+    if (!t->Init(rest.substr(0, colon), std::stoi(rest.substr(colon + 1)),
+                 rank, size, error))
+      return nullptr;
+    return t;
+  }
+  if (error) *error = "unknown transport spec: " + spec;
+  return nullptr;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,46 @@
+// Control-plane transports: how request/response bytes move between ranks.
+//
+// The reference's control plane is MPI_Gather/MPI_Gatherv to rank 0 plus
+// MPI_Bcast back (reference: horovod/common/operations.cc:1843-1864,
+// 1953-1993).  There is no MPI on a TPU pod; the idiomatic substrate for
+// host-side coordination is plain TCP over the DCN (what
+// jax.distributed's own coordination service rides).  Two implementations:
+//
+//  * LocalTransport — N ranks inside one process rendezvous through a
+//    shared in-memory world.  This is the test harness, mirroring how the
+//    reference simulates multi-node with `mpirun -np N` on one host
+//    (SURVEY.md §4), and the backend for single-host multi-rank setups.
+//  * TcpTransport — rank 0 listens, workers connect; length-prefixed
+//    frames, strictly tick-aligned (gather then bcast per tick), which is
+//    exactly the lockstep MPI gave the reference.
+
+#ifndef HVDTPU_TRANSPORT_H_
+#define HVDTPU_TRANSPORT_H_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+
+  // Every rank contributes `payload`; on rank 0, `out` receives all ranks'
+  // payloads indexed by rank.  Blocking; one call per tick per rank.
+  virtual bool GatherToRoot(const std::string& payload,
+                            std::vector<std::string>* out) = 0;
+
+  // Rank 0 sends `payload`; every rank's `out` receives it.
+  virtual bool BcastFromRoot(const std::string& payload, std::string* out) = 0;
+};
+
+// spec: "local:<world-name>"  (in-process rendezvous; created on demand)
+//       "tcp:<host>:<port>"   (rank 0 binds <host>:<port>; workers connect)
+std::unique_ptr<Transport> MakeTransport(const std::string& spec, int rank,
+                                         int size, std::string* error);
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TRANSPORT_H_

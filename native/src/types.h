@@ -1,0 +1,107 @@
+// Core control-plane types for the horovod_tpu native coordination engine.
+//
+// TPU-native re-design of the reference's common types
+// (reference: horovod/common/common.h:28-110 Status/TensorShape and
+// horovod/common/mpi_message.h:26-172 request/response vocabulary).  The
+// data plane here is XLA collectives driven from Python, so the native
+// layer carries only *metadata*: named-tensor requests, readiness state,
+// and fused execution batches.  No tensor payload ever crosses this layer.
+
+#ifndef HVDTPU_TYPES_H_
+#define HVDTPU_TYPES_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Collective kinds.  SPARSE is the shyhuai-fork top-k path
+// (reference horovod/torch/__init__.py:46-83).
+enum class OpKind : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kSparse = 3,
+};
+
+// Dtype vocabulary (JAX-facing; sizes used only for fusion accounting).
+enum class DType : uint8_t {
+  kU8 = 0,
+  kI8 = 1,
+  kU16 = 2,
+  kI16 = 3,
+  kI32 = 4,
+  kI64 = 5,
+  kF16 = 6,
+  kBF16 = 7,
+  kF32 = 8,
+  kF64 = 9,
+  kBool = 10,
+  kU32 = 11,
+  kU64 = 12,
+};
+
+inline int DTypeSize(DType d) {
+  switch (d) {
+    case DType::kU8:
+    case DType::kI8:
+    case DType::kBool:
+      return 1;
+    case DType::kU16:
+    case DType::kI16:
+    case DType::kF16:
+    case DType::kBF16:
+      return 2;
+    case DType::kI32:
+    case DType::kU32:
+    case DType::kF32:
+      return 4;
+    case DType::kI64:
+    case DType::kU64:
+    case DType::kF64:
+      return 8;
+  }
+  return 1;
+}
+
+// A named-tensor collective request from one rank.
+struct Request {
+  OpKind kind = OpKind::kAllreduce;
+  DType dtype = DType::kF32;
+  int32_t rank = 0;
+  int32_t root_rank = 0;
+  int64_t group = -1;  // caller-delimited fusion group; -1 = none
+  std::string name;
+  std::vector<int64_t> shape;  // per-rank (local) shape
+
+  int64_t PayloadBytes() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n * DTypeSize(dtype);
+  }
+};
+
+struct RequestList {
+  bool shutdown = false;
+  std::vector<Request> requests;
+};
+
+// One fused execution batch: every rank dispatches the named tensors of a
+// batch as ONE collective program, in list order.  A non-empty `error`
+// aborts those tensors only (reference semantics: mismatch errors fail the
+// op, not the job — horovod/common/operations.cc:516-519).
+struct Batch {
+  OpKind kind = OpKind::kAllreduce;
+  std::string error;
+  std::vector<std::string> names;
+};
+
+struct BatchList {
+  bool shutdown = false;
+  std::vector<Batch> batches;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TYPES_H_

@@ -1,0 +1,162 @@
+// Compact little-endian wire format for the control plane.
+//
+// The reference serializes its control messages with flatbuffers
+// (reference: horovod/common/wire/mpi_message.fbs + 1.8k vendored LoC).
+// The payloads here are tiny (names + shapes at ~5 ms cadence), so a
+// hand-rolled length-prefixed format is simpler, has zero dependencies,
+// and is trivially fuzzable.  All integers little-endian; strings and
+// vectors are length-prefixed.
+
+#ifndef HVDTPU_WIRE_H_
+#define HVDTPU_WIRE_H_
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "types.h"
+
+namespace hvdtpu {
+namespace wire {
+
+class Writer {
+ public:
+  std::string Take() { return std::move(buf_); }
+
+  void U8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  void U32(uint32_t v) { Raw(&v, 4); }
+  void I32(int32_t v) { Raw(&v, 4); }
+  void I64(int64_t v) { Raw(&v, 8); }
+  void Str(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    buf_.append(s);
+  }
+
+ private:
+  void Raw(const void* p, size_t n) {
+    buf_.append(reinterpret_cast<const char*>(p), n);
+  }
+  std::string buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+  explicit Reader(const std::string& s)
+      : Reader(reinterpret_cast<const uint8_t*>(s.data()), s.size()) {}
+
+  uint8_t U8() {
+    Need(1);
+    return *p_++;
+  }
+  uint32_t U32() {
+    uint32_t v;
+    Need(4);
+    std::memcpy(&v, p_, 4);
+    p_ += 4;
+    return v;
+  }
+  int32_t I32() {
+    int32_t v;
+    Need(4);
+    std::memcpy(&v, p_, 4);
+    p_ += 4;
+    return v;
+  }
+  int64_t I64() {
+    int64_t v;
+    Need(8);
+    std::memcpy(&v, p_, 8);
+    p_ += 8;
+    return v;
+  }
+  std::string Str() {
+    uint32_t n = U32();
+    Need(n);
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  bool Done() const { return p_ == end_; }
+
+ private:
+  void Need(size_t n) const {
+    if (static_cast<size_t>(end_ - p_) < n)
+      throw std::runtime_error("hvdtpu wire: truncated message");
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+inline std::string SerializeRequestList(const RequestList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.U32(static_cast<uint32_t>(rl.requests.size()));
+  for (const Request& r : rl.requests) {
+    w.U8(static_cast<uint8_t>(r.kind));
+    w.U8(static_cast<uint8_t>(r.dtype));
+    w.I32(r.rank);
+    w.I32(r.root_rank);
+    w.I64(r.group);
+    w.Str(r.name);
+    w.U32(static_cast<uint32_t>(r.shape.size()));
+    for (int64_t d : r.shape) w.I64(d);
+  }
+  return w.Take();
+}
+
+inline RequestList ParseRequestList(Reader& rd) {
+  RequestList rl;
+  rl.shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  rl.requests.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    Request r;
+    r.kind = static_cast<OpKind>(rd.U8());
+    r.dtype = static_cast<DType>(rd.U8());
+    r.rank = rd.I32();
+    r.root_rank = rd.I32();
+    r.group = rd.I64();
+    r.name = rd.Str();
+    uint32_t nd = rd.U32();
+    r.shape.reserve(nd);
+    for (uint32_t j = 0; j < nd; ++j) r.shape.push_back(rd.I64());
+    rl.requests.push_back(std::move(r));
+  }
+  return rl;
+}
+
+inline std::string SerializeBatchList(const BatchList& bl) {
+  Writer w;
+  w.U8(bl.shutdown ? 1 : 0);
+  w.U32(static_cast<uint32_t>(bl.batches.size()));
+  for (const Batch& b : bl.batches) {
+    w.U8(static_cast<uint8_t>(b.kind));
+    w.Str(b.error);
+    w.U32(static_cast<uint32_t>(b.names.size()));
+    for (const std::string& nm : b.names) w.Str(nm);
+  }
+  return w.Take();
+}
+
+inline BatchList ParseBatchList(Reader& rd) {
+  BatchList bl;
+  bl.shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  bl.batches.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    Batch b;
+    b.kind = static_cast<OpKind>(rd.U8());
+    b.error = rd.Str();
+    uint32_t m = rd.U32();
+    b.names.reserve(m);
+    for (uint32_t j = 0; j < m; ++j) b.names.push_back(rd.Str());
+    bl.batches.push_back(std::move(b));
+  }
+  return bl;
+}
+
+}  // namespace wire
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_WIRE_H_

@@ -1,0 +1,287 @@
+"""Native coordination engine: negotiation, fusion, validation, stall,
+shutdown, and the eager-engine integration.
+
+Mirrors the reference's coordinator-protocol behavior (reference:
+horovod/common/operations.cc RunLoopOnce :1795-2007, response fusion
+:1916-1943, mismatch errors :335-537 — exercised there by
+test/test_tensorflow.py:249-320's negative tests under mpirun).  Multi-rank
+negotiation is driven by N threads, each owning a rank's controller over an
+in-process transport — the single-host analogue of ``mpirun -np N``.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="libhvdtpu.so could not be built"
+)
+
+AR = native.KIND_ALLREDUCE
+AG = native.KIND_ALLGATHER
+BC = native.KIND_BROADCAST
+
+
+def run_ranks(size, body, *, transport=None, threshold=1 << 20, stall_s=60.0):
+    """Spawn one thread per rank, each with its own controller; returns the
+    per-rank results of ``body(rank, controller)``."""
+    spec = transport or f"local:{uuid.uuid4().hex}"
+    results = [None] * size
+    errors = []
+
+    def runner(rank):
+        try:
+            ctrl = native.NativeController(
+                rank=rank, size=size, transport_spec=spec,
+                fusion_threshold_bytes=threshold, stall_warning_s=stall_s,
+            )
+            try:
+                results[rank] = body(rank, ctrl)
+            finally:
+                ctrl.close()
+        except Exception as e:  # pragma: no cover - surfaced via errors
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=runner, args=(r,)) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "rank thread hung (negotiation deadlock)"
+    assert not errors, f"rank errors: {errors}"
+    return results
+
+
+def drain(ctrl, n_names):
+    """Tick until n_names tensor names have been batched; returns batches."""
+    out = []
+    got = 0
+    while got < n_names:
+        bl = ctrl.tick()
+        for b in bl.batches:
+            out.append(b)
+            got += len(b.names)
+    return out
+
+
+def test_agreement_and_fusion_across_ranks():
+    """Ranks submit in different orders; all must agree on one fused order
+    (the core coordinator property, reference operations.cc:1795-2007)."""
+
+    def body(rank, ctrl):
+        names = ["gr.a", "gr.b", "gr.c"]
+        order = names[rank % 3:] + names[:rank % 3]
+        for n in order:
+            ctrl.submit(AR, "float32", n, (8, 4))
+        return drain(ctrl, 3)
+
+    results = run_ranks(4, body)
+    assert len(results[0]) == 1  # fused into one batch
+    assert sorted(results[0][0].names) == ["gr.a", "gr.b", "gr.c"]
+    for r in range(1, 4):
+        assert [b.names for b in results[r]] == [b.names for b in results[0]]
+
+
+def test_fusion_respects_threshold_and_dtype():
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "t.f32a", (100,))   # 400 B
+        ctrl.submit(AR, "float32", "t.f32b", (100,))   # 400 B -> splits
+        ctrl.submit(AR, "bfloat16", "t.bf16", (100,))  # dtype change
+        return drain(ctrl, 3)
+
+    batches = run_ranks(2, body, threshold=600)[0]
+    assert [len(b.names) for b in batches] == [1, 1, 1]
+
+    def body2(rank, ctrl):
+        ctrl.submit(AR, "float32", "u.a", (10,))
+        ctrl.submit(AR, "float32", "u.b", (10,))
+        ctrl.submit(AR, "bfloat16", "u.c", (10,))
+        return drain(ctrl, 3)
+
+    batches = run_ranks(2, body2, threshold=1 << 20)[0]
+    assert [sorted(b.names) for b in batches] == [["u.a", "u.b"], ["u.c"]]
+
+
+def test_fusion_respects_group():
+    """Different fusion groups (distinct reduce op / compression) never
+    merge even with matching dtype."""
+
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "g.sum", (4,), group=0)
+        ctrl.submit(AR, "float32", "g.min", (4,), group=1)
+        return drain(ctrl, 2)
+
+    batches = run_ranks(2, body)[0]
+    assert [b.names for b in batches] == [["g.sum"], ["g.min"]]
+
+
+def test_shape_mismatch_is_error_on_all_ranks():
+    """Even-vs-odd-rank shapes → error batch everywhere (reference
+    negative test shape, test_tensorflow.py:249-283)."""
+
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "bad.shape", (8 if rank % 2 else 4,))
+        return drain(ctrl, 1)
+
+    for batches in run_ranks(2, body):
+        assert "Mismatched allreduce tensor shapes" in batches[0].error
+
+
+def test_dtype_mismatch_is_error():
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32" if rank == 0 else "int32", "bad.dtype", (4,))
+        return drain(ctrl, 1)
+
+    for batches in run_ranks(2, body):
+        assert "Mismatched tensor dtypes" in batches[0].error
+
+
+def test_ragged_allgather_allowed_but_trailing_dims_checked():
+    def body(rank, ctrl):
+        ctrl.submit(AG, "float32", "ag.ok", (rank + 1, 7))   # ragged dim 0 ok
+        ctrl.submit(AG, "float32", "ag.bad", (2, rank + 3))  # trailing differ
+        return drain(ctrl, 2)
+
+    for batches in run_ranks(2, body):
+        by_name = {b.names[0]: b for b in batches}
+        assert by_name["ag.ok"].error == ""
+        assert "trailing dims" in by_name["ag.bad"].error
+
+
+def test_broadcast_root_mismatch_is_error():
+    def body(rank, ctrl):
+        ctrl.submit(BC, "float32", "bc.bad", (4,), root_rank=rank)
+        return drain(ctrl, 1)
+
+    for batches in run_ranks(2, body):
+        assert "root_rank" in batches[0].error
+
+
+def test_duplicate_submit_does_not_release_early():
+    """A rank double-submitting a name must not satisfy the all-ranks-seen
+    condition for a rank that never submitted; the duplicate surfaces as an
+    error once all ranks HAVE reported."""
+
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "dup.x", (4,))
+        if rank == 0:
+            ctrl.submit(AR, "float32", "dup.x", (4,))  # duplicate in flight
+        got = list(ctrl.tick().batches)
+        while not got:
+            got = list(ctrl.tick().batches)
+        return got
+
+    for batches in run_ranks(2, body):
+        assert "Duplicate tensor name" in batches[0].error
+
+
+def test_uint32_supported_on_the_wire():
+    def body(rank, ctrl):
+        ctrl.submit(AR, "uint32", "u32.x", (4,))
+        return drain(ctrl, 1)
+
+    assert run_ranks(2, body)[0][0].error == ""
+
+
+def test_stall_report_names_missing_ranks():
+    """Rank 0's table reports tensors stuck waiting on specific ranks
+    (reference CheckForStalledTensors, operations.cc:1424-1470)."""
+
+    def body(rank, ctrl):
+        if rank == 0:
+            ctrl.submit(AR, "float32", "lonely", (4,))
+        ctrl.tick()
+        return ctrl.stall_report()
+
+    reports = run_ranks(3, body, stall_s=0.0)
+    assert "lonely" in reports[0]
+    assert "missing ranks: 1 2" in reports[0]
+    assert reports[1] == "" and reports[2] == ""
+
+
+def test_shutdown_propagates_to_all_ranks():
+    def body(rank, ctrl):
+        if rank == 1:
+            ctrl.request_shutdown()
+        bl = ctrl.tick()
+        return bl.shutdown
+
+    assert all(run_ranks(3, body))
+
+
+def test_tcp_transport_agreement():
+    """Same negotiation over real sockets (the multi-host control plane)."""
+
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "tcp.x", (4,))
+        ctrl.submit(AR, "float32", "tcp.y", (4,))
+        return drain(ctrl, 2)
+
+    results = run_ranks(2, body, transport="tcp:127.0.0.1:19872")
+    assert [b.names for b in results[0]] == [b.names for b in results[1]]
+
+
+# ---------------------------------------------------------------------------
+# Eager-engine integration: the native controller drives dispatch.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def native_engine_world(monkeypatch):
+    """Re-init horovod_tpu with the native controller forced on."""
+    monkeypatch.setenv("HOROVOD_TPU_NATIVE_CONTROLLER", "on")
+    monkeypatch.setenv(
+        "HOROVOD_TPU_CONTROLLER_TRANSPORT", f"local:{uuid.uuid4().hex}"
+    )
+    hvd.shutdown()
+    hvd.init()
+    yield
+    hvd.shutdown()
+    monkeypatch.delenv("HOROVOD_TPU_NATIVE_CONTROLLER")
+    monkeypatch.delenv("HOROVOD_TPU_CONTROLLER_TRANSPORT")
+    hvd.init()
+
+
+def test_eager_engine_native_dispatch(native_engine_world):
+    """Collectives negotiated through the native engine produce the same
+    values as the pure-Python path."""
+    x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+    out = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(np.asarray(out), np.full(3, 3.5))
+
+    from horovod_tpu.basics import _state
+
+    assert _state.engine.controller is not None  # native path actually on
+
+    b = hvd.broadcast(hvd.per_rank(lambda r: jnp.asarray([r])), root_rank=5)
+    assert np.asarray(b).tolist() == [5]
+
+    g = hvd.allgather([jnp.ones((r % 2 + 1, 2)) * r for r in range(8)])
+    assert g.shape == (sum(r % 2 + 1 for r in range(8)), 2)
+
+
+def test_eager_engine_native_fused_group(native_engine_world):
+    outs = hvd.grouped_allreduce_eager(
+        [hvd.per_rank(lambda r, i=i: jnp.full((4,), float(r + i)))
+         for i in range(5)],
+        average=True,
+    )
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), np.full(4, 3.5 + i))
+
+
+def test_eager_engine_duplicate_name_errors(native_engine_world):
+    x = hvd.per_rank(lambda r: jnp.ones((2,)))
+    h1 = hvd.allreduce_async(x, name="dup")
+    h2 = hvd.allreduce_async(x, name="dup")
+    hvd.synchronize(h1)
+    with pytest.raises(RuntimeError, match="Duplicate tensor name"):
+        hvd.synchronize(h2)
