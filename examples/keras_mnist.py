@@ -1,0 +1,54 @@
+"""MNIST through the ``fit`` frontend with callbacks.
+
+Equivalent of reference examples/keras_mnist.py: wrap the optimizer, add
+``BroadcastGlobalVariablesCallback``, call fit — three-line distribution.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/keras_mnist.py --epochs 2
+"""
+
+import argparse
+
+import jax
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.data import ShardedLoader, synthetic_mnist
+from horovod_tpu.models.mnist import MnistMLP
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-per-chip", type=int, default=32)
+    p.add_argument("--base-lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    model = MnistMLP()
+    images, labels = synthetic_mnist(4096)
+    params = model.init(jax.random.key(0), images[:1])["params"]
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    params, opt_state, history = hvd.fit(
+        params,
+        hvd.DistributedOptimizer(optax.adam(args.base_lr * hvd.size())),
+        loss_fn,
+        ShardedLoader((images, labels), args.batch_per_chip),
+        epochs=args.epochs,
+        callbacks=[
+            hvd.BroadcastGlobalVariablesCallback(0),
+            hvd.MetricAverageCallback(),
+        ],
+        verbose=hvd.rank() == 0,
+    )
+    if hvd.rank() == 0:
+        print("final loss:", history[-1]["loss"])
+
+
+if __name__ == "__main__":
+    main()

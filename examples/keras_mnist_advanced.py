@@ -1,0 +1,112 @@
+"""MNIST with the full callback stack: warmup, schedule, metric averaging.
+
+Equivalent of reference examples/keras_mnist_advanced.py:84-96 — LR warmup
+to lr·size over 5 epochs, staircase decay windows after, metric averaging,
+broadcast at start.  The LR lives in ``opt_state`` via
+``optax.inject_hyperparams`` so callbacks can set it between epochs
+(the functional analogue of ``K.set_value(model.optimizer.lr, ...)``).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/keras_mnist_advanced.py --epochs 3
+"""
+
+import argparse
+
+import jax
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.data import ShardedLoader, synthetic_mnist
+from horovod_tpu.models.mnist import MnistConvNet
+
+
+def set_lr(state, lr):
+    params, opt_state = state
+    opt_state.hyperparams["learning_rate"] = lr
+    return (params, opt_state)
+
+
+def scale_momentum(state, factor):
+    """Momentum correction on LR change (reference _keras/callbacks.py:
+    126-138): rescale trace buffers so accumulated velocity stays
+    consistent with the new LR."""
+    params, opt_state = state
+    inner = opt_state.inner_state
+    trace = jax.tree.map(lambda t: t * factor, inner[0].trace)
+    inner = (inner[0]._replace(trace=trace),) + tuple(inner[1:])
+    return (params, opt_state._replace(inner_state=inner))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-per-chip", type=int, default=32)
+    p.add_argument("--base-lr", type=float, default=0.01)
+    p.add_argument("--warmup-epochs", type=float, default=3.0)
+    args = p.parse_args()
+
+    hvd.init()
+    model = MnistConvNet()
+    images, labels = synthetic_mnist(4096)
+    eval_images, eval_labels = synthetic_mnist(1024, seed=9)
+    params = model.init(jax.random.key(0), images[:1])["params"]
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def eval_metric_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        return {"accuracy": (logits.argmax(-1) == y).mean()}
+
+    tx = hvd.DistributedOptimizer(
+        optax.inject_hyperparams(
+            lambda learning_rate: optax.sgd(learning_rate, momentum=0.9)
+        )(learning_rate=args.base_lr)
+    )
+
+    callbacks = [
+        hvd.BroadcastGlobalVariablesCallback(0),
+        hvd.MetricAverageCallback(),
+        # Warmup: lr -> lr*size over the first epochs (reference :91).
+        hvd.LearningRateWarmupCallback(
+            args.base_lr, warmup_epochs=args.warmup_epochs,
+            set_lr=set_lr, verbose=True,
+        ),
+        # Staircase decay windows after warmup (reference :92-95).
+        hvd.LearningRateScheduleCallback(
+            args.base_lr * hvd.size(), multiplier=1.0,
+            start_epoch=args.warmup_epochs, end_epoch=5,
+            set_lr=set_lr, scale_momentum=scale_momentum,
+        ),
+        hvd.LearningRateScheduleCallback(
+            args.base_lr * hvd.size(), multiplier=1e-1,
+            start_epoch=5, end_epoch=7,
+            set_lr=set_lr, scale_momentum=scale_momentum,
+        ),
+        hvd.LearningRateScheduleCallback(
+            args.base_lr * hvd.size(), multiplier=1e-2, start_epoch=7,
+            set_lr=set_lr, scale_momentum=scale_momentum,
+        ),
+    ]
+
+    params, opt_state, history = hvd.fit(
+        params, tx, loss_fn,
+        ShardedLoader((images, labels), args.batch_per_chip),
+        epochs=args.epochs,
+        callbacks=callbacks,
+        eval_loader=ShardedLoader(
+            (eval_images, eval_labels), args.batch_per_chip, shuffle=False
+        ),
+        eval_metric_fn=eval_metric_fn,
+        verbose=hvd.rank() == 0,
+    )
+    if hvd.rank() == 0:
+        print("history:", history[-1])
+
+
+if __name__ == "__main__":
+    main()

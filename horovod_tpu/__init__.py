@@ -93,6 +93,9 @@ from horovod_tpu.checkpoint import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
 )
+from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer  # noqa: F401
+from horovod_tpu.training import fit  # noqa: F401
+from horovod_tpu.data import ShardedLoader, shard_indices  # noqa: F401
 from horovod_tpu import ops  # noqa: F401
 
 __version__ = "0.1.0"

@@ -185,6 +185,8 @@ class NativeController:
             raise RuntimeError(f"native submit rejected request {name!r}")
 
     def tick(self) -> BatchList:
+        if not self._ptr:
+            return BatchList(shutdown=True)
         out = ctypes.POINTER(ctypes.c_ubyte)()
         n = ctypes.c_uint64()
         rc = self._lib.hvdtpu_controller_tick(
@@ -201,6 +203,8 @@ class NativeController:
         self._lib.hvdtpu_controller_request_shutdown(self._ptr)
 
     def stall_report(self) -> str:
+        if not self._ptr:
+            return ""
         out = ctypes.POINTER(ctypes.c_ubyte)()
         n = ctypes.c_uint64()
         self._lib.hvdtpu_controller_stall_report(

@@ -94,7 +94,6 @@ class EagerEngine:
         self._tick = threading.Event()
         self.controller = self._maybe_native_controller(cfg)
         self._submitted: dict[str, _PendingOp] = {}
-        self._fuse_group_ids: dict[tuple, int] = {}
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )
@@ -232,18 +231,23 @@ class EagerEngine:
     def _controller_group(self, p: _PendingOp) -> int:
         """Encode fusability (reduce op, compression) into the controller's
         int64 ``group`` so negotiation never merges requests that need
-        different compiled programs.  Caller-delimited group ids are NOT
-        part of the key: with true negotiation the batch order is globally
-        agreed, so cross-group merging is safe — and keying on per-call ids
-        would grow this cache by one entry per training step."""
+        different compiled programs.
+
+        The id must be a pure function of the key — NOT encounter order,
+        which differs across ranks when flush timing differs, and would let
+        the controller fuse a Sum with a Min (dispatched with group[0]'s op
+        → silently wrong numerics).  Caller-delimited group ids are not
+        included: with true negotiation the batch order is globally agreed,
+        so cross-group merging is safe."""
         if p.kind != "allreduce":
             return -1
-        key = (p.op.name, p.compression)
-        gid = self._fuse_group_ids.get(key)
-        if gid is None:
-            gid = len(self._fuse_group_ids)
-            self._fuse_group_ids[key] = gid
-        return gid
+        comp = getattr(p.compression, "__name__", None) or type(
+            p.compression
+        ).__name__
+        token = f"{p.op.name}:{comp}".encode()
+        import hashlib
+
+        return int.from_bytes(hashlib.sha1(token).digest()[:7], "big")
 
     def _flush_via_controller(self, batch: list[_PendingOp]) -> None:
         """Submit new requests, run one negotiation tick, dispatch the

@@ -168,7 +168,22 @@ def make_train_step(
         out_specs=TrainStepResult(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+    jitted = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+    if jax.default_backend() != "cpu":
+        return jitted
+
+    def throttled(params, opt_state, batch):
+        # CPU-simulation only: XLA's in-process CPU collectives deadlock
+        # (40 s rendezvous abort) when many launches of a collective module
+        # are in flight at once — the N virtual devices share one thread
+        # pool, so deep async dispatch can starve a device thread out of an
+        # active rendezvous.  Blocking per step caps the in-flight depth at
+        # 1; on TPU the async pipeline is left untouched.
+        out = jitted(params, opt_state, batch)
+        jax.block_until_ready(out.loss)
+        return out
+
+    return throttled
 
 
 # ---------------------------------------------------------------------------

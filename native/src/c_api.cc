@@ -42,16 +42,23 @@ void* hvdtpu_controller_create(int rank, int size, const char* transport_spec,
                                long long fusion_threshold_bytes,
                                double stall_warning_s, char* err_buf,
                                int err_len) {
-  std::string error;
-  auto transport =
-      hvdtpu::MakeTransport(transport_spec ? transport_spec : "", rank, size,
-                            &error);
-  if (!transport) {
-    FillError(err_buf, err_len, error);
+  // No exception may cross the C ABI (std::stoi on a malformed tcp port,
+  // bad_alloc, ...): report through err_buf instead.
+  try {
+    std::string error;
+    auto transport =
+        hvdtpu::MakeTransport(transport_spec ? transport_spec : "", rank, size,
+                              &error);
+    if (!transport) {
+      FillError(err_buf, err_len, error);
+      return nullptr;
+    }
+    return new Controller(rank, size, std::move(transport),
+                          fusion_threshold_bytes, stall_warning_s);
+  } catch (const std::exception& e) {
+    FillError(err_buf, err_len, e.what());
     return nullptr;
   }
-  return new Controller(rank, size, std::move(transport),
-                        fusion_threshold_bytes, stall_warning_s);
 }
 
 void hvdtpu_controller_destroy(void* ctrl) {
@@ -75,6 +82,7 @@ int hvdtpu_controller_submit(void* ctrl, unsigned char kind,
 }
 
 void hvdtpu_controller_request_shutdown(void* ctrl) {
+  if (!ctrl) return;
   static_cast<Controller*>(ctrl)->RequestShutdown();
 }
 
@@ -82,6 +90,7 @@ void hvdtpu_controller_request_shutdown(void* ctrl) {
 // transport failure.  *out/*out_len receive wire-format BatchList bytes;
 // free with hvdtpu_free.
 int hvdtpu_controller_tick(void* ctrl, uint8_t** out, uint64_t* out_len) {
+  if (!ctrl) return -1;
   BatchList bl;
   bool live;
   try {
@@ -95,6 +104,7 @@ int hvdtpu_controller_tick(void* ctrl, uint8_t** out, uint64_t* out_len) {
 
 int hvdtpu_controller_stall_report(void* ctrl, uint8_t** out,
                                    uint64_t* out_len) {
+  if (!ctrl) return -1;
   *out = CopyOut(static_cast<Controller*>(ctrl)->StallReport(), out_len);
   return 0;
 }

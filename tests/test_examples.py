@@ -1,0 +1,70 @@
+"""Example-as-E2E smoke runs — the reference CI seds its examples small and
+runs each under mpirun (reference: .travis.yml script block; SURVEY.md §4).
+Here each example runs in-process on the 8-rank CPU mesh with tiny args.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+
+def run_example(name: str, argv: list[str]) -> None:
+    old = sys.argv
+    sys.argv = [name] + argv
+    try:
+        runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_jax_mnist(tmp_path):
+    run_example(
+        "jax_mnist.py",
+        ["--epochs", "1", "--batch-per-chip", "4", "--samples", "256",
+         "--ckpt-dir", str(tmp_path)],
+    )
+    assert any(p.startswith("step_") for p in os.listdir(tmp_path))
+
+
+def test_jax_mnist_eager():
+    run_example(
+        "jax_mnist_eager.py",
+        ["--epochs", "1", "--batch-per-chip", "4", "--samples", "256"],
+    )
+
+
+def test_keras_mnist_advanced():
+    run_example(
+        "keras_mnist_advanced.py",
+        ["--epochs", "2", "--batch-per-chip", "4", "--warmup-epochs", "1"],
+    )
+
+
+def test_word2vec_sparse():
+    run_example(
+        "jax_word2vec.py",
+        ["--steps", "3", "--batch-per-chip", "8", "--vocab", "128",
+         "--dim", "16", "--sparse"],
+    )
+
+
+def test_llama_finetune_tiny():
+    run_example(
+        "llama_finetune.py",
+        ["--tiny", "--steps", "2", "--seq-len", "64"],
+    )
+
+
+@pytest.mark.slow
+def test_resnet50_smoke(tmp_path):
+    run_example(
+        "keras_imagenet_resnet50.py",
+        ["--epochs", "1", "--smoke", "--batch-per-chip", "2",
+         "--ckpt-dir", str(tmp_path)],
+    )
