@@ -1,5 +1,6 @@
-"""Parallelism strategies: DP (the reference capability), plus TP/SP/ring
-attention as TPU-native extensions (SURVEY.md §2.3 checklist)."""
+"""Parallelism strategies: DP (the reference capability), plus TP/SP (ring
+and Ulysses attention), PP (GPipe-style pipeline), and EP (MoE all-to-all,
+horovod_tpu.models.moe) as TPU-native extensions (SURVEY.md §2.3)."""
 
 from horovod_tpu.parallel.attention import (  # noqa: F401
     blockwise_attention,
@@ -9,3 +10,8 @@ from horovod_tpu.parallel.attention import (  # noqa: F401
 )
 from horovod_tpu.parallel.flash_attention import flash_attention  # noqa: F401
 from horovod_tpu.parallel.mesh import data_parallel_mesh, make_mesh  # noqa: F401
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_forward,
+    pipeline_loss_fn,
+    stack_stage_params,
+)

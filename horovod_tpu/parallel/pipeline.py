@@ -1,0 +1,129 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+No reference equivalent (the reference is data-parallel only, SURVEY.md
+§2.3); this supplies the PP axis of the parallelism matrix, TPU-first:
+
+* Stages are mesh positions on the ``pp`` axis; stage-to-stage transfer is
+  one ``lax.ppermute`` hop per tick — nearest-neighbour ICI traffic.
+* The schedule is a single ``lax.scan`` over ``M + S - 1`` ticks (fill +
+  steady state + drain), so the whole pipeline is ONE compiled program —
+  no per-microbatch dispatch from Python.
+* Backward needs no extra code: ``ppermute`` transposes to the reverse
+  permutation under ``jax.grad``, so reverse-mode AD derives the 1F1B-ish
+  backward communication automatically.
+
+Use under ``shard_map`` with ``in_specs`` placing ``stage_params`` leading
+axis and the microbatch axis of ``x`` on the ``pp`` axis — see
+:func:`pipeline_loss_fn` for the packaged form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_forward(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis.
+
+    Args:
+      stage_fn: ``(params_for_this_stage, activations) -> activations``;
+        activations keep one shape across stages.
+      stage_params: this device's stage parameters.  NOTE: under shard_map
+        a ``P('pp', ...)`` in_spec shards the stacked leading axis down to
+        size 1 but does NOT squeeze it — strip it first
+        (``jax.tree.map(lambda a: a[0], params)``), as
+        :func:`pipeline_loss_fn` does.
+      x: microbatched input ``[M, mb, ...]``, meaningful on stage 0 (other
+        stages may pass the same array; it is ignored there).
+
+    Returns:
+      ``[M, mb, ...]`` outputs, valid on the LAST stage (zeros elsewhere —
+      mask by ``lax.axis_index(axis_name) == S-1`` when reducing a loss;
+      :func:`pipeline_loss_fn` does this for you).
+    """
+    s = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = x.shape[0]
+    ticks = m + s - 1
+
+    fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        recv, ys = carry
+        # Stage 0 injects microbatch t (fill phase); later stages consume
+        # what the previous tick's ppermute delivered.
+        mb = lax.dynamic_index_in_dim(x, jnp.minimum(t, m - 1), 0,
+                                      keepdims=False)
+        inp = jnp.where(stage == 0, mb.astype(recv.dtype), recv)
+        out = stage_fn(stage_params, inp)
+        # Last stage banks its result at microbatch slot t - (S - 1).
+        slot = t - (s - 1)
+        ys = lax.cond(
+            (stage == s - 1) & (slot >= 0),
+            lambda ys: lax.dynamic_update_index_in_dim(ys, out, jnp.maximum(slot, 0), 0),
+            lambda ys: ys,
+            ys,
+        )
+        recv = lax.ppermute(out, axis_name, fwd_perm)
+        return (recv, ys), None
+
+    recv0 = jnp.zeros_like(stage_fn(stage_params, x[0]))
+    ys0 = jnp.zeros((m,) + recv0.shape, recv0.dtype)
+    (_, ys), _ = lax.scan(tick, (recv0, ys0), jnp.arange(ticks))
+    return ys
+
+
+def pipeline_loss_fn(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    *,
+    axis_name: str = "pp",
+) -> Callable[[Any, tuple[jax.Array, Any]], jax.Array]:
+    """Package a per-stage body + final loss into a pipeline loss.
+
+    Returns ``fn(stage_params, (x_micro, target_micro)) -> scalar`` for use
+    under shard_map: runs the pipeline, evaluates ``loss_fn(outputs,
+    targets)`` per microbatch on the last stage, and ``psum``s the masked
+    mean so every stage returns the same scalar (gradients flow back
+    through the ppermute chain).
+    """
+
+    def fn(stage_params, batch):
+        # Consume the pp-sharded leading axis (shard_map shards it to
+        # size 1 but does not squeeze it).
+        stage_params = jax.tree.map(lambda a: a[0], stage_params)
+        x_micro, tgt_micro = batch
+        ys = pipeline_forward(stage_fn, stage_params, x_micro,
+                              axis_name=axis_name)
+        s = lax.axis_size(axis_name)
+        is_last = (lax.axis_index(axis_name) == s - 1).astype(jnp.float32)
+        losses = jax.vmap(loss_fn)(ys, tgt_micro)       # [M]
+        local = jnp.mean(losses) * is_last
+        # VALUE: replicate via psum so every stage reports the true loss.
+        # GRADIENT: must flow from the LOCAL term only — under
+        # value_and_grad-inside-shard_map every device seeds a cotangent
+        # for its replicated copy, and psum's transpose would sum those S
+        # seeds into an S-times-too-large gradient.  stop_gradient on the
+        # correction keeps the grad path single-sourced (the last stage),
+        # whose cotangents reach earlier stages through the ppermute
+        # transposes.
+        total = lax.psum(local, axis_name)
+        return local + lax.stop_gradient(total - local)
+
+    return fn
+
+
+def stack_stage_params(params_per_stage: list) -> Any:
+    """Stack per-stage parameter pytrees on a leading axis for ``pp``
+    sharding (``in_specs=P('pp', ...)`` consumes it under shard_map)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)
