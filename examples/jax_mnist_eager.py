@@ -46,7 +46,6 @@ def main():
         sparse_ratio=0.05,
     )
     opt_state = opt.init(params)
-    # device_put=False: the eager frontend shards batches itself.
     loader = ShardedLoader((images, labels), args.batch_per_chip, seed=1)
 
     for epoch in range(args.epochs):

@@ -9,8 +9,9 @@ matrix, TPU-first:
   (the standard TPU MoE formulation; GPU implementations sort tokens
   instead, which XLA:TPU would handle poorly).
 * **Top-k router** (top-2 default) with softmax gates renormalized over
-  the selected experts and the load-balancing auxiliary loss of
-  Shazeer-style MoE (mean(frac_tokens · frac_router_prob) · E · k).
+  the selected experts and the Switch-Transformer load-balancing
+  auxiliary loss: ``E · sum_e(frac_tokens_e · mean_router_prob_e)``,
+  where frac_tokens counts first-choice assignments (no top-k factor).
 * **Expert parallelism**: expert-stacked weights ``[E, ...]`` shard over
   the ``ep`` mesh axis via :func:`param_partition_specs`; under ``jit``
   GSPMD turns the dispatch/combine einsums into all-to-alls over ICI.
@@ -107,7 +108,7 @@ def route(cfg: MoEConfig, logits: jax.Array) -> tuple[jax.Array, jax.Array, jax.
         "kte,ktc,tk->tec", choice_oh, pos_oh, gate_vals.astype(jnp.float32)
     )
 
-    # Load-balancing aux loss (Shazeer): E · mean_e(frac_tokens_e · mean_prob_e).
+    # Load-balancing aux loss (Switch): E · sum_e(frac_tokens_e · mean_prob_e).
     frac_tokens = choice_oh[0].mean(0)          # first-choice assignment share
     mean_prob = probs.mean(0)
     aux = cfg.n_experts * jnp.sum(frac_tokens * mean_prob)

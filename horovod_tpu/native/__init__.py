@@ -20,10 +20,24 @@ import subprocess
 import threading
 from dataclasses import dataclass, field
 
+from horovod_tpu.native import _build_flags
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
 _SO_PATH = os.path.join(_HERE, "libhvdtpu.so")
-_SRC_DIR = os.path.join(_REPO, "native", "src")
+
+
+def _find_src_dir() -> str:
+    """Locate the native sources: repo layout first, then the copy the
+    package build vendors into horovod_tpu/native/src (setup.py)."""
+    for cand in (os.path.join(_REPO, "native", "src"),
+                 os.path.join(_HERE, "src")):
+        if os.path.exists(os.path.join(cand, "controller.cc")):
+            return cand
+    return os.path.join(_REPO, "native", "src")
+
+
+_SRC_DIR = _find_src_dir()
 
 # OpKind / DType wire values — must match native/src/types.h.
 KIND_ALLREDUCE, KIND_ALLGATHER, KIND_BROADCAST, KIND_SPARSE = 0, 1, 2, 3
@@ -42,21 +56,38 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _sources() -> list[str]:
+    srcs = [os.path.join(_SRC_DIR, f) for f in _build_flags.SOURCES]
+    headers = [os.path.join(_SRC_DIR, f) for f in _build_flags.HEADERS]
+    return srcs + [h for h in headers if os.path.exists(h)]
+
+
+def _so_stale() -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    return any(os.path.getmtime(s) > so_mtime for s in _sources()
+               if os.path.exists(s))
+
+
 def _build_so() -> None:
-    srcs = [os.path.join(_SRC_DIR, f)
-            for f in ("controller.cc", "transport.cc", "c_api.cc")]
+    srcs = [s for s in _sources() if s.endswith(".cc")]
     if not all(os.path.exists(s) for s in srcs):
         raise NativeBuildError(
             f"native sources not found under {_SRC_DIR}; "
             "cannot build libhvdtpu.so"
         )
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-o", _SO_PATH] + srcs
+    # Compile to a per-pid temp path and rename into place: rename is atomic
+    # on one filesystem, so concurrent first-use builds from multiple local
+    # ranks can never dlopen a partially-written .so.
+    tmp = f"{_SO_PATH}.tmp.{os.getpid()}"
+    cmd = [_build_flags.CXX, *_build_flags.CXXFLAGS, "-o", tmp] + srcs
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(
             "building libhvdtpu.so failed:\n" + proc.stderr[-2000:]
         )
+    os.replace(tmp, _SO_PATH)
 
 
 def load_library() -> ctypes.CDLL:
@@ -67,7 +98,7 @@ def load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        if _so_stale():
             _build_so()
         lib = ctypes.CDLL(_SO_PATH, mode=ctypes.RTLD_GLOBAL)
         lib.hvdtpu_controller_create.restype = ctypes.c_void_p

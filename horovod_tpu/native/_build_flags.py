@@ -1,0 +1,20 @@
+"""Single source of truth for the native engine's compile line.
+
+Imported by the first-use builder (``horovod_tpu.native.load_library``) and
+loaded by path from ``setup.py``'s pre-build step, so wheels and first-use
+builds can never drift apart on flags or source lists.  Stdlib-only: this
+module must be importable in a build environment with no jax installed.
+"""
+
+CXX = "g++"
+CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+SOURCES = ("controller.cc", "transport.cc", "c_api.cc")
+HEADERS = ("controller.h", "transport.h", "types.h", "wire.h")
+
+
+def compile_cmd(out_path: str, src_dir: str) -> list[str]:
+    import os
+
+    return [CXX, *CXXFLAGS, "-o", out_path] + [
+        os.path.join(src_dir, s) for s in SOURCES
+    ]

@@ -90,16 +90,19 @@ void hvdtpu_controller_request_shutdown(void* ctrl) {
 // transport failure.  *out/*out_len receive wire-format BatchList bytes;
 // free with hvdtpu_free.
 int hvdtpu_controller_tick(void* ctrl, uint8_t** out, uint64_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
   if (!ctrl) return -1;
   BatchList bl;
-  bool live;
+  hvdtpu::TickStatus st;
   try {
-    live = static_cast<Controller*>(ctrl)->Tick(&bl);
+    st = static_cast<Controller*>(ctrl)->Tick(&bl);
   } catch (const std::exception&) {
     return -1;
   }
+  if (st == hvdtpu::TickStatus::kTransportError) return -1;
   *out = CopyOut(hvdtpu::wire::SerializeBatchList(bl), out_len);
-  return live ? 0 : 1;
+  return st == hvdtpu::TickStatus::kShutdown ? 1 : 0;
 }
 
 int hvdtpu_controller_stall_report(void* ctrl, uint8_t** out,

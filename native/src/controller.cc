@@ -161,10 +161,10 @@ BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
   return bl;
 }
 
-bool Controller::Tick(BatchList* out) {
+TickStatus Controller::Tick(BatchList* out) {
   if (shut_down_) {
     out->shutdown = true;
-    return false;
+    return TickStatus::kShutdown;
   }
   RequestList mine;
   {
@@ -174,7 +174,7 @@ bool Controller::Tick(BatchList* out) {
   }
   std::vector<std::string> gathered;
   if (!transport_->GatherToRoot(wire::SerializeRequestList(mine), &gathered))
-    return false;
+    return TickStatus::kTransportError;
 
   std::string response_bytes;
   if (rank_ == 0) {
@@ -192,11 +192,12 @@ bool Controller::Tick(BatchList* out) {
     response_bytes = wire::SerializeBatchList(built);
   }
   std::string received;
-  if (!transport_->BcastFromRoot(response_bytes, &received)) return false;
+  if (!transport_->BcastFromRoot(response_bytes, &received))
+    return TickStatus::kTransportError;
   wire::Reader rd(received);
   *out = wire::ParseBatchList(rd);
   if (out->shutdown) shut_down_ = true;
-  return !out->shutdown;
+  return out->shutdown ? TickStatus::kShutdown : TickStatus::kLive;
 }
 
 std::string Controller::StallReport() {

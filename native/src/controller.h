@@ -32,6 +32,11 @@
 
 namespace hvdtpu {
 
+// Outcome of one negotiation tick.  Transport failure is NOT shutdown:
+// the Python layer must fail outstanding handles on kTransportError but
+// treat kShutdown as the clean coordinated exit.
+enum class TickStatus { kLive, kShutdown, kTransportError };
+
 class Controller {
  public:
   Controller(int rank, int size, std::unique_ptr<Transport> transport,
@@ -48,8 +53,9 @@ class Controller {
   void RequestShutdown();
 
   // Run one negotiation round: gather -> match -> fuse -> bcast.
-  // Returns false once a shutdown response has been observed (sticky).
-  bool Tick(BatchList* out);
+  // kShutdown once a shutdown response has been observed (sticky);
+  // kTransportError when the control plane is broken (gather/bcast failed).
+  TickStatus Tick(BatchList* out);
 
   // Rank-0 stall summary: tensors requested by a subset of ranks for longer
   // than the warning threshold, with the missing ranks (empty if none).

@@ -52,8 +52,7 @@ void TestAgreementAndFusion() {
       for (auto& r : reqs) c->Submit(r);
       BatchList bl;
       while (results[rank].batches.empty()) {
-        bool live = c->Tick(&bl);
-        assert(live);
+        assert(c->Tick(&bl) == TickStatus::kLive);
         for (auto& b : bl.batches) results[rank].batches.push_back(b);
       }
     });
@@ -81,7 +80,7 @@ void TestThresholdSplit() {
       BatchList bl;
       size_t total = 0;
       while (total < 3) {
-        assert(c->Tick(&bl));
+        assert(c->Tick(&bl) == TickStatus::kLive);
         for (auto& b : bl.batches) {
           total += b.names.size();
           results[rank].batches.push_back(b);
@@ -108,7 +107,7 @@ void TestShapeMismatch() {
       c->Submit(AR("bad", {rank ? 4 : 8}));  // even vs odd shapes
       BatchList bl;
       while (results[rank].batches.empty()) {
-        assert(c->Tick(&bl));
+        assert(c->Tick(&bl) == TickStatus::kLive);
         for (auto& b : bl.batches) results[rank].batches.push_back(b);
       }
     });
@@ -130,8 +129,8 @@ void TestShutdown() {
       auto c = MakeLocal("shutdown", rank, kSize, 1 << 20);
       if (rank == 1) c->RequestShutdown();
       BatchList bl;
-      bool live = c->Tick(&bl);
-      assert(!live && bl.shutdown);
+      assert(c->Tick(&bl) == TickStatus::kShutdown);
+      assert(bl.shutdown);
     });
   }
   for (auto& t : threads) t.join();
@@ -154,7 +153,7 @@ void TestTcp() {
       BatchList bl;
       size_t total = 0;
       while (total < 2) {
-        assert(c.Tick(&bl));
+        assert(c.Tick(&bl) == TickStatus::kLive);
         for (auto& b : bl.batches) {
           total += b.names.size();
           results[rank].batches.push_back(b);

@@ -123,9 +123,16 @@ bool SendFrame(int fd, const std::string& payload) {
   return SendAll(fd, &len, 4) && SendAll(fd, payload.data(), payload.size());
 }
 
+// Control-plane frames carry names/shapes at millisecond cadence; anything
+// approaching this bound is corruption (or an attack), not a real message.
+// Failing the transport beats letting one bad length prefix drive a ~4 GiB
+// allocation on rank 0's tick.
+constexpr uint32_t kMaxFrameBytes = 64u << 20;  // 64 MiB
+
 bool RecvFrame(int fd, std::string* out) {
   uint32_t len = 0;
   if (!RecvAll(fd, &len, 4)) return false;
+  if (len > kMaxFrameBytes) return false;
   out->resize(len);
   return len == 0 || RecvAll(fd, &(*out)[0], len);
 }

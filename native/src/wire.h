@@ -78,6 +78,18 @@ class Reader {
     return s;
   }
   bool Done() const { return p_ == end_; }
+  size_t Remaining() const { return static_cast<size_t>(end_ - p_); }
+
+  // Read an element count and validate it against the bytes actually left
+  // in the buffer (each element needs >= min_elem_bytes).  A corrupt or
+  // hostile count prefix must fail the parse, not drive a giant reserve().
+  uint32_t Count(size_t min_elem_bytes) {
+    uint32_t n = U32();
+    if (min_elem_bytes == 0) min_elem_bytes = 1;
+    if (static_cast<size_t>(n) > Remaining() / min_elem_bytes)
+      throw std::runtime_error("hvdtpu wire: implausible element count");
+    return n;
+  }
 
  private:
   void Need(size_t n) const {
@@ -108,7 +120,8 @@ inline std::string SerializeRequestList(const RequestList& rl) {
 inline RequestList ParseRequestList(Reader& rd) {
   RequestList rl;
   rl.shutdown = rd.U8() != 0;
-  uint32_t n = rd.U32();
+  // Min fixed bytes per request: kind+dtype+rank+root+group+2 counts = 26.
+  uint32_t n = rd.Count(26);
   rl.requests.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     Request r;
@@ -118,7 +131,7 @@ inline RequestList ParseRequestList(Reader& rd) {
     r.root_rank = rd.I32();
     r.group = rd.I64();
     r.name = rd.Str();
-    uint32_t nd = rd.U32();
+    uint32_t nd = rd.Count(8);
     r.shape.reserve(nd);
     for (uint32_t j = 0; j < nd; ++j) r.shape.push_back(rd.I64());
     rl.requests.push_back(std::move(r));
@@ -142,13 +155,14 @@ inline std::string SerializeBatchList(const BatchList& bl) {
 inline BatchList ParseBatchList(Reader& rd) {
   BatchList bl;
   bl.shutdown = rd.U8() != 0;
-  uint32_t n = rd.U32();
+  // Min fixed bytes per batch: kind + error len + name count = 9.
+  uint32_t n = rd.Count(9);
   bl.batches.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     Batch b;
     b.kind = static_cast<OpKind>(rd.U8());
     b.error = rd.Str();
-    uint32_t m = rd.U32();
+    uint32_t m = rd.Count(4);
     b.names.reserve(m);
     for (uint32_t j = 0; j < m; ++j) b.names.push_back(rd.Str());
     bl.batches.push_back(std::move(b));

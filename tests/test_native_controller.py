@@ -229,6 +229,48 @@ def test_tcp_transport_agreement():
     assert [b.names for b in results[0]] == [b.names for b in results[1]]
 
 
+def test_transport_failure_raises_not_shutdown():
+    """A dead control plane must surface as an error tick (rc=-1), not a
+    benign empty BatchList or a clean shutdown — otherwise outstanding
+    collective handles hang forever instead of being failed (the reference
+    fails callbacks with an error on engine death, operations.cc:278-283)."""
+    spec = "tcp:127.0.0.1:19873"
+    closed = threading.Event()
+    outcome = {}
+
+    def rank1():
+        ctrl = native.NativeController(
+            rank=1, size=2, transport_spec=spec,
+            fusion_threshold_bytes=1 << 20,
+        )
+        ctrl.close()  # dies without negotiating shutdown
+        closed.set()
+
+    def rank0():
+        ctrl = native.NativeController(
+            rank=0, size=2, transport_spec=spec,
+            fusion_threshold_bytes=1 << 20,
+        )
+        assert closed.wait(30)
+        try:
+            bl = ctrl.tick()
+            outcome["result"] = ("tick", bl.shutdown, len(bl.batches))
+        except RuntimeError as e:
+            outcome["result"] = ("raised", str(e))
+        finally:
+            ctrl.close()
+
+    threads = [threading.Thread(target=rank1), threading.Thread(target=rank0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "transport-failure test hung"
+    assert outcome["result"][0] == "raised", (
+        f"expected a transport error, got {outcome['result']}"
+    )
+
+
 # ---------------------------------------------------------------------------
 # Eager-engine integration: the native controller drives dispatch.
 # ---------------------------------------------------------------------------
