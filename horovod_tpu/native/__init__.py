@@ -65,6 +65,13 @@ def _sources() -> list[str]:
 def _so_stale() -> bool:
     if not os.path.exists(_SO_PATH):
         return True
+    if _SRC_DIR != os.path.join(_REPO, "native", "src"):
+        # Installed layout: pip extracts files with arbitrary mtimes, so a
+        # wheel's prebuilt .so must be trusted as-is, never "refreshed" —
+        # a rebuild there would discard the prebuild (or fail on read-only
+        # site-packages / missing g++).  Staleness only means anything in
+        # the repo layout, where sources are actually edited.
+        return False
     so_mtime = os.path.getmtime(_SO_PATH)
     return any(os.path.getmtime(s) > so_mtime for s in _sources()
                if os.path.exists(s))
@@ -81,7 +88,7 @@ def _build_so() -> None:
     # on one filesystem, so concurrent first-use builds from multiple local
     # ranks can never dlopen a partially-written .so.
     tmp = f"{_SO_PATH}.tmp.{os.getpid()}"
-    cmd = [_build_flags.CXX, *_build_flags.CXXFLAGS, "-o", tmp] + srcs
+    cmd = _build_flags.compile_cmd(tmp, _SRC_DIR)
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(

@@ -201,21 +201,19 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     Single-controller: the controller already holds THE copy, so this
     re-places leaves with replicated sharding over the mesh (the
     device-broadcast XLA would emit) and returns them.  Multi-controller:
-    process 0's values are broadcast to all hosts over DCN
-    (``multihost_utils.broadcast_one_to_all``), matching root_rank
-    semantics for the host that owns device ``root_rank``.
+    the values of the process owning device ``root_rank`` travel to all
+    hosts over DCN — a direct one-to-all when the root lives on process 0,
+    else a process allgather + select (the reference supports any
+    ``root_rank``, horovod/torch/__init__.py:270-299).
     """
     basics._require_init()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         root_process = list(basics.mesh().devices.flat)[root_rank].process_index
-        if root_process != 0:
-            raise NotImplementedError(
-                "multi-host broadcast_parameters currently requires the root "
-                "device to live on process 0"
-            )
-        return multihost_utils.broadcast_one_to_all(params)
+        return multihost_utils.broadcast_one_to_all(
+            params, is_source=jax.process_index() == root_process
+        )
     sharding = basics.replicated_sharding()
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), params)
 

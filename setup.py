@@ -77,7 +77,7 @@ class BuildPyWithNative(build_py):
         if not all(os.path.exists(s) for s in srcs):
             return
         so = os.path.join(out_dir, "libhvdtpu.so")
-        cmd = [_build_flags.CXX, *_build_flags.CXXFLAGS, "-o", so] + srcs
+        cmd = _build_flags.compile_cmd(so, os.path.join(out_dir, "src"))
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
         except FileNotFoundError:

@@ -1,0 +1,101 @@
+"""Worker process for the true multi-process end-to-end test.
+
+Launched by tests/test_multiprocess.py as N real OS processes — the
+analogue of the reference's ``mpirun -np 2 pytest`` CI model
+(reference: .travis.yml; SURVEY.md §4) — with the coordination env
+pre-set:
+
+  HOROVOD_TPU_COORDINATOR        jax.distributed coordinator address
+  HOROVOD_TPU_NUM_PROCESSES      world process count
+  HOROVOD_TPU_PROCESS_ID         this process's id
+  HOROVOD_TPU_NATIVE_CONTROLLER  on  (force the native engine)
+  HOROVOD_TPU_CONTROLLER_TRANSPORT  tcp:127.0.0.1:<port>
+
+Each process drives one CPU device; the global mesh spans both processes,
+so every collective here really crosses a process boundary, and the eager
+path really negotiates over the native TCP controller.
+
+Prints one final line ``WORKER_OK {json}`` on success; any assertion or
+crash fails the launcher's rc check.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import horovod_tpu as hvd
+
+    hvd.init()  # consumes HOROVOD_TPU_* env → jax.distributed.initialize
+    n = hvd.size()
+    me = jax.process_index()
+    assert hvd.cross_size() == int(os.environ["HOROVOD_TPU_NUM_PROCESSES"])
+    assert hvd.cross_rank() == int(os.environ["HOROVOD_TPU_PROCESS_ID"])
+
+    # --- broadcast_parameters from process-0-owned root (fast path).
+    params = {
+        "w": np.full((4,), float(me), np.float32),
+        "b": np.full((2,), 10.0 + me, np.float32),
+    }
+    out0 = hvd.broadcast_parameters(params, root_rank=0)
+    assert np.allclose(np.asarray(out0["w"]), 0.0), out0
+    assert np.allclose(np.asarray(out0["b"]), 10.0), out0
+
+    # --- broadcast_parameters from a root on ANOTHER process (general path;
+    # the reference supports any root, torch/__init__.py:270-299).
+    last = n - 1
+    out1 = hvd.broadcast_parameters(params, root_rank=last)
+    root_proc = list(hvd.mesh().devices.flat)[last].process_index
+    assert np.allclose(np.asarray(out1["w"]), float(root_proc)), out1
+
+    # --- broadcast_object (resume-epoch pattern).
+    obj = {"epoch": 7, "note": "hello"} if hvd.cross_rank() == 0 else None
+    got = hvd.broadcast_object(obj, root_rank=0)
+    assert got == {"epoch": 7, "note": "hello"}, got
+
+    # --- eager allreduce through the native TCP controller.
+    from horovod_tpu.ops import eager as eager_mod
+
+    eng = eager_mod._engine()
+    assert eng.controller is not None, (
+        "native controller was not brought up despite "
+        "HOROVOD_TPU_NATIVE_CONTROLLER=on"
+    )
+
+    x = hvd.from_per_rank([np.arange(4.0, dtype=np.float32) + r for r in range(n)])
+    h = hvd.allreduce_async(x, average=True, name="mp.grad")
+    out = hvd.synchronize(h)
+    expected = np.arange(4.0) + (n - 1) / 2.0
+    local = np.asarray(jax.device_get(out))
+    assert np.allclose(local.reshape(-1, 4), expected), (local, expected)
+
+    # Two named tensors submitted in DIFFERENT per-process order: the
+    # controller must converge both on one agreed order (the negotiation
+    # job, reference operations.cc:1795-2007).
+    names = ["mp.a", "mp.b"] if me == 0 else ["mp.b", "mp.a"]
+    handles = {
+        nm: hvd.allreduce_async(
+            hvd.from_per_rank([np.full((3,), float(r)) for r in range(n)]),
+            name=nm,
+        )
+        for nm in names
+    }
+    for nm, hh in handles.items():
+        val = np.asarray(jax.device_get(hvd.synchronize(hh)))
+        assert np.allclose(val.reshape(-1, 3), sum(range(n))), (nm, val)
+
+    hvd.shutdown()
+    print("WORKER_OK " + json.dumps({"rank": me, "size": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
