@@ -1,6 +1,6 @@
-"""Synthetic ResNet-101 throughput benchmark — images/sec/chip.
+"""Synthetic throughput benchmark — images/sec/chip, MFU, fusion delta.
 
-TPU-native re-implementation of the reference's benchmark method: the only
+TPU-native re-implementation of the reference's benchmark method.  The only
 absolute throughput number the reference publishes is tf_cnn_benchmarks
 ``--model resnet101 --batch_size 64 --variable_update horovod`` → "total
 images/sec: 1656.82" on 16 Pascal GPUs (/root/reference/docs/benchmarks.md:
@@ -10,7 +10,18 @@ gradient averaging) so ``vs_baseline`` is apples-to-apples; the timing loop
 shape (mean over groups of batches) mirrors the in-repo harness
 /root/reference/examples/pytorch_synthetic_benchmark.py:96-110.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Beyond the reference's img/sec, the primary line carries TPU-first metrics:
+
+* ``mfu`` — model FLOPs utilization, computed from XLA's own cost analysis
+  of the compiled step (not hand-counted FLOPs) against the chip's peak.
+* ``extras.llama_*`` — tokens/sec/chip + MFU on a ~110M-param Llama with the
+  pallas flash-attention kernel at seq 2048 (the flagship-model hot path).
+* ``extras.fusion_speedup`` — VGG-16-shaped eager gradient set pushed
+  through the engine with ``HOROVOD_FUSION_THRESHOLD`` at its 64 MiB default
+  vs 0, proving the Tensor Fusion knob is observable
+  (/root/reference/docs/tensor-fusion.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -24,6 +35,19 @@ import jax.numpy as jnp
 import optax
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # reference docs/benchmarks.md
+
+# Peak dense-matmul FLOP/s per chip by device kind (bf16).  Substring match,
+# most specific first.
+_PEAK_FLOPS = (
+    ("v6", 918e12),       # Trillium
+    ("v5 lite", 197e12),  # v5e ("TPU v5 lite")
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
 
 def _probe_tpu(timeout_s: float) -> bool:
@@ -73,23 +97,63 @@ def _init_backend() -> str:
         return jax.default_backend()
 
 
-def main() -> None:
-    import horovod_tpu as hvd
+def _peak_flops_per_chip() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _step_flops(jitted, *args) -> float | None:
+    """Per-device FLOPs of one compiled step, from XLA's cost analysis.
+
+    ``cost_analysis()`` reports the per-device SPMD module's work, not the
+    global program's — which is exactly the numerator per-chip MFU wants.
+    """
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _mfu(flops_per_step_per_chip: float | None,
+         steps_per_sec: float) -> float | None:
+    peak = _peak_flops_per_chip()
+    if flops_per_step_per_chip is None or peak is None:
+        return None
+    return flops_per_step_per_chip * steps_per_sec / peak
+
+
+def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
+    """Mean steps/sec over ``num_iters`` groups of ``num_batches`` steps."""
+    rates = []
+    for _ in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches):
+            sync = step_once()
+        jax.block_until_ready(sync)
+        rates.append(num_batches / (time.perf_counter() - t0))
+    return sum(rates) / len(rates)
+
+
+def _bench_resnet(hvd, on_tpu: bool) -> dict:
     from horovod_tpu.models.resnet import ResNet101
 
-    on_tpu = _init_backend() == "tpu"
     batch_per_chip = int(
-        os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "4")
+        os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "2")
     )
     image_size = int(
         os.environ.get("HVD_TPU_BENCH_IMG", "224" if on_tpu else "32")
     )
-    num_iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "10" if on_tpu else "2"))
+    num_iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "5" if on_tpu else "1"))
     num_batches = int(
-        os.environ.get("HVD_TPU_BENCH_BATCHES", "10" if on_tpu else "2")
+        os.environ.get("HVD_TPU_BENCH_BATCHES", "10" if on_tpu else "1")
     )
-
-    hvd.init()
     n = hvd.size()
     model = ResNet101(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
 
@@ -114,30 +178,169 @@ def main() -> None:
 
     tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
     opt_state = tx.init(params)
-    step = hvd.make_train_step(loss_fn, tx)
+    step = hvd.make_train_step(loss_fn, tx, donate=False)
 
+    flops = _step_flops(step, params, opt_state, (images, labels))
     out = step(params, opt_state, (images, labels))  # compile + warmup
-    params, opt_state = out.params, out.opt_state
     jax.block_until_ready(out.loss)
 
-    rates = []
-    for _ in range(num_iters):
-        t0 = time.perf_counter()
-        for _ in range(num_batches):
-            out = step(params, opt_state, (images, labels))
-            params, opt_state = out.params, out.opt_state
-        jax.block_until_ready(out.loss)
-        dt = time.perf_counter() - t0
-        rates.append(global_bs * num_batches / dt)
+    state = {"p": out.params, "o": out.opt_state}
 
-    total = sum(rates) / len(rates)
-    per_chip = total / n
-    print(json.dumps({
+    def one():
+        r = step(state["p"], state["o"], (images, labels))
+        state["p"], state["o"] = r.params, r.opt_state
+        return r.loss
+
+    steps_per_sec = _time_loop(one, num_iters, num_batches)
+    per_chip = steps_per_sec * global_bs / n
+    return {
+        "images_per_sec_per_chip": round(per_chip, 2),
+        "mfu": _mfu(flops, steps_per_sec),
+        "flops_per_step": flops,
+    }
+
+
+def _bench_llama(hvd, on_tpu: bool) -> dict:
+    """Tokens/sec/chip + MFU on the flagship transformer (flash attention)."""
+    from horovod_tpu.models import llama
+
+    n = hvd.size()
+    if on_tpu:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
+            ffn_dim=4096, max_seq_len=2048, attn_impl="flash", remat=False,
+        )
+        batch_per_chip, seq = 4, 2048
+        iters, batches = 3, 8
+    else:
+        cfg = llama.llama_tiny(attn_impl="flash")
+        batch_per_chip, seq = 2, 128
+        iters, batches = 1, 1
+    loss = llama.make_loss_fn(cfg)
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4))
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(loss, tx, donate=False)
+
+    tokens = jnp.zeros((batch_per_chip * n, seq), jnp.int32)
+    batch = (tokens, tokens)
+    flops = _step_flops(step, params, opt_state, batch)
+    out = step(params, opt_state, batch)
+    jax.block_until_ready(out.loss)
+    state = {"p": out.params, "o": out.opt_state}
+
+    def one():
+        r = step(state["p"], state["o"], batch)
+        state["p"], state["o"] = r.params, r.opt_state
+        return r.loss
+
+    steps_per_sec = _time_loop(one, iters, batches)
+    return {
+        "llama_tokens_per_sec_per_chip": round(
+            steps_per_sec * batch_per_chip * seq, 1
+        ),
+        "llama_mfu": _mfu(flops, steps_per_sec),
+        "llama_params": llama.num_params(cfg),
+    }
+
+
+def _bench_fusion(hvd, on_tpu: bool) -> dict:
+    """Tensor Fusion on/off on a VGG-16-shaped eager gradient set.
+
+    The reference's signature perf feature: many small allreduces batched
+    into one 64 MiB fused collective.  Pushing VGG-16's ~32 gradient tensors
+    through the eager engine with the threshold at its default vs 0 measures
+    exactly the per-collective dispatch overhead fusion exists to amortize.
+    """
+    import numpy as np
+
+    from horovod_tpu.models.vgg import VGG16
+
+    # VGG-16 parameter shapes only (no training) — the fusion workload.
+    model = VGG16(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.ones((1, 32, 32, 3)))["params"]
+    leaves = [jnp.asarray(x) for x in jax.tree.leaves(params)]
+    n = hvd.size()
+    grads = [jnp.broadcast_to(x, (n, *x.shape)) for x in leaves]
+    rounds = int(
+        os.environ.get("HVD_TPU_BENCH_FUSION_ROUNDS", "5" if on_tpu else "2")
+    )
+
+    def run_config(threshold: str) -> float:
+        hvd.shutdown()
+        os.environ["HOROVOD_FUSION_THRESHOLD"] = threshold
+        os.environ["HOROVOD_CYCLE_TIME"] = "1"
+        hvd.init()
+        hvd.grouped_allreduce_eager(grads, average=True)  # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            outs = hvd.grouped_allreduce_eager(grads, average=True)
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / rounds
+
+    try:
+        fused_s = run_config(str(64 * 1024 * 1024))
+        unfused_s = run_config("0")
+        return {
+            "fusion_speedup": round(unfused_s / fused_s, 3),
+            "fused_ms": round(fused_s * 1e3, 2),
+            "unfused_ms": round(unfused_s * 1e3, 2),
+            "fusion_tensors": len(grads),
+        }
+    finally:
+        os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
+        os.environ.pop("HOROVOD_CYCLE_TIME", None)
+        hvd.shutdown()
+        hvd.init()
+
+
+def _note(msg: str, t0: float) -> None:
+    import sys
+
+    print(f"[bench +{time.monotonic() - t0:.0f}s] {msg}", file=sys.stderr)
+
+
+def main() -> None:
+    t_start = time.monotonic()
+    budget_s = float(os.environ.get("HVD_TPU_BENCH_BUDGET", "360"))
+    on_tpu = _init_backend() == "tpu"
+    _note(f"backend resolved: {'tpu' if on_tpu else jax.default_backend()}",
+          t_start)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    result = _bench_resnet(hvd, on_tpu)
+    _note(f"resnet done: {result}", t_start)
+    per_chip = result["images_per_sec_per_chip"]
+
+    extras: dict = {
+        "device": jax.devices()[0].device_kind,
+        "n_chips": hvd.size(),
+        "resnet101_flops_per_step_per_chip": result["flops_per_step"],
+    }
+    # Optional sub-benchmarks, each fenced by the remaining time budget so
+    # the primary JSON line is never lost to a driver timeout.
+    for fn in (_bench_llama, _bench_fusion):
+        if time.monotonic() - t_start > budget_s:
+            extras.setdefault("skipped", []).append(fn.__name__)
+            continue
+        try:
+            extras.update(fn(hvd, on_tpu))
+            _note(f"{fn.__name__} done", t_start)
+        except Exception as exc:  # a failed extra never kills the line
+            extras[fn.__name__ + "_error"] = f"{type(exc).__name__}: {exc}"
+
+    line = {
         "metric": "resnet101_synthetic_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": per_chip,
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-    }))
+    }
+    if result["mfu"] is not None:
+        line["mfu"] = round(result["mfu"], 4)
+    line["extras"] = extras
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -131,6 +131,14 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
             ctypes.POINTER(ctypes.c_uint64),
         ]
+        lib.hvdtpu_controller_enable_tick_trace.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.hvdtpu_controller_drain_ticks.restype = ctypes.c_int
+        lib.hvdtpu_controller_drain_ticks.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.hvdtpu_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
         _lib = lib
         return _lib
@@ -251,6 +259,31 @@ class NativeController:
             return ctypes.string_at(out, n.value).decode()
         finally:
             self._lib.hvdtpu_free(out)
+
+    def enable_tick_trace(self, on: bool = True) -> None:
+        """Record per-rank request arrivals on rank 0 (timeline NEGOTIATE
+        ticks, reference timeline.cc:98-132).  Off by default."""
+        if self._ptr:
+            self._lib.hvdtpu_controller_enable_tick_trace(self._ptr, int(on))
+
+    def drain_ticks(self) -> list[tuple[str, int]]:
+        """Drain buffered (tensor_name, rank) arrival events (rank 0)."""
+        if not self._ptr:
+            return []
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = ctypes.c_uint64()
+        self._lib.hvdtpu_controller_drain_ticks(
+            self._ptr, ctypes.byref(out), ctypes.byref(n))
+        try:
+            text = ctypes.string_at(out, n.value).decode()
+        finally:
+            self._lib.hvdtpu_free(out)
+        events = []
+        for line in text.splitlines():
+            rank_str, _, name = line.partition(" ")
+            if name:
+                events.append((name, int(rank_str)))
+        return events
 
     def close(self) -> None:
         if self._ptr:

@@ -93,6 +93,10 @@ class EagerEngine:
         self._shutdown = threading.Event()
         self._tick = threading.Event()
         self.controller = self._maybe_native_controller(cfg)
+        if self.controller is not None and self.timeline is not None:
+            # Per-rank NEGOTIATE ticks on rank 0's timeline
+            # (reference timeline.cc:98-132); drained after every tick.
+            self.controller.enable_tick_trace()
         self._submitted: dict[str, _PendingOp] = {}
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
@@ -138,6 +142,16 @@ class EagerEngine:
                     )
                 # auto multi-host with no transport configured: fall back to
                 # Python coordination (caller-delimited fusion groups only).
+                print(
+                    "WARNING: horovod_tpu eager collectives on a multi-host "
+                    "job without HOROVOD_TPU_CONTROLLER_TRANSPORT: falling "
+                    "back to Python coordination.  Only caller-delimited "
+                    "groups (grouped_allreduce_eager) will fuse, and "
+                    "cross-host agreement relies on identical program "
+                    "order; set HOROVOD_TPU_CONTROLLER_TRANSPORT="
+                    "tcp:<rank0-host>:<port> to enable true negotiation.",
+                    file=sys.stderr,
+                )
                 return None
             import os as _os
 
@@ -159,6 +173,11 @@ class EagerEngine:
         pending.enqueued_at = time.monotonic()
         if self.timeline:
             self.timeline.start(pending.name, timeline_mod.NEGOTIATE + "_" + pending.kind.upper())
+            if self.controller is None:
+                # Single controller: one thread observes every enqueue, so
+                # all ranks' readiness arrives at once — one tick covers the
+                # reference's per-rank tick events (timeline.cc:98-132).
+                self.timeline.instant(pending.name, "NEGOTIATE_TICK_ALL")
         with self._lock:
             if self._shutdown.is_set():
                 raise RuntimeError("horovod_tpu engine has been shut down")
@@ -288,6 +307,9 @@ class EagerEngine:
                 self.handles.mark_error(p.handle, e)
             self._submitted.clear()
             raise
+        if self.timeline:
+            for tname, trank in self.controller.drain_ticks():
+                self.timeline.instant(tname, f"NEGOTIATE_TICK_r{trank}")
         for b in bl.batches:
             ops = [
                 self._submitted.pop(n) for n in b.names if n in self._submitted

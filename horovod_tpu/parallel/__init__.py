@@ -7,6 +7,9 @@ from horovod_tpu.parallel.attention import (  # noqa: F401
     dense_attention,
     ring_attention,
     ulysses_attention,
+    zigzag_positions,
+    zigzag_shard,
+    zigzag_unshard,
 )
 from horovod_tpu.parallel.flash_attention import flash_attention  # noqa: F401
 from horovod_tpu.parallel.mesh import data_parallel_mesh, make_mesh  # noqa: F401

@@ -87,10 +87,16 @@ def _init_state(q: jax.Array) -> _SoftmaxState:
 def _block_update(
     state: _SoftmaxState,
     q: jax.Array, k: jax.Array, v: jax.Array,
-    *, causal: bool, q_offset, kv_offset, kv_valid: jax.Array | None = None,
+    *, causal: bool, q_offset=0, kv_offset=0,
+    q_positions: jax.Array | None = None,
+    kv_positions: jax.Array | None = None,
+    kv_valid: jax.Array | None = None,
 ) -> _SoftmaxState:
     """Fold one KV block into the running softmax state.
 
+    ``q_positions``/``kv_positions``: optional explicit [Lq]/[Lk] global
+    position vectors for non-contiguous sequence layouts (zig-zag ring
+    sharding); they override the ``*_offset + arange`` default.
     ``kv_valid``: optional [Lk] bool mask for padded tail keys.
     """
     b, lq, h, d = q.shape
@@ -101,8 +107,10 @@ def _block_update(
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
-        qpos = q_offset + jnp.arange(lq)[:, None]
-        kpos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        qpos = (q_positions if q_positions is not None
+                else q_offset + jnp.arange(lq))[:, None]
+        kpos = (kv_positions if kv_positions is not None
+                else kv_offset + jnp.arange(k.shape[1]))[None, :]
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     if kv_valid is not None:
         s = jnp.where(kv_valid[None, None, None, :], s, NEG_INF)
@@ -158,9 +166,59 @@ def blockwise_attention(
     return _finalize(state, q.dtype)
 
 
+def zigzag_positions(rank, n: int, local_len: int) -> jax.Array:
+    """Global positions of rank ``rank``'s local sequence slice under
+    zig-zag sharding: the sequence is cut into ``2n`` blocks and rank r
+    holds blocks ``r`` (head half) and ``2n-1-r`` (tail half), so every
+    rank's causal workload is equal.  ``rank`` may be a traced scalar."""
+    block = local_len // 2
+    head = rank * block + jnp.arange(block)
+    tail = (2 * n - 1 - rank) * block + jnp.arange(block)
+    return jnp.concatenate([head, tail])
+
+
+def zigzag_shard(x: jax.Array, n: int, *, axis: int = 1) -> jax.Array:
+    """Reorder a global sequence axis so that *contiguous* sharding over an
+    ``n``-way mesh axis hands each rank its zig-zag block pair.
+
+    View the sequence as ``2n`` blocks ``[0..2n)``; the output lays them out
+    as ``0, 2n-1, 1, 2n-2, ..., n-1, n`` so slice r of the contiguous shard
+    is blocks ``(r, 2n-1-r)``.  Inverse: :func:`zigzag_unshard`.
+    """
+    l = x.shape[axis]
+    if l % (2 * n):
+        raise ValueError(f"sequence length {l} not divisible by 2n={2 * n}")
+    block = l // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend([r, 2 * n - 1 - r])
+    xs = jnp.moveaxis(x, axis, 0).reshape(2 * n, block, *[
+        s for i, s in enumerate(x.shape) if i != axis
+    ])
+    xs = xs[jnp.asarray(order)]
+    return jnp.moveaxis(xs.reshape(l, *xs.shape[2:]), 0, axis)
+
+
+def zigzag_unshard(x: jax.Array, n: int, *, axis: int = 1) -> jax.Array:
+    """Inverse permutation of :func:`zigzag_shard`."""
+    l = x.shape[axis]
+    block = l // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend([r, 2 * n - 1 - r])
+    inverse = [0] * (2 * n)
+    for pos, blk in enumerate(order):
+        inverse[blk] = pos
+    xs = jnp.moveaxis(x, axis, 0).reshape(2 * n, block, *[
+        s for i, s in enumerate(x.shape) if i != axis
+    ])
+    xs = xs[jnp.asarray(inverse)]
+    return jnp.moveaxis(xs.reshape(l, *xs.shape[2:]), 0, axis)
+
+
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
-    causal: bool = True,
+    causal: bool = True, zigzag: bool = False,
 ) -> jax.Array:
     """Sequence-parallel ring attention over ``axis_name``.
 
@@ -171,15 +229,23 @@ def ring_attention(
     softmax.  n-1 permutes, O(L/n) memory per chip, compute/comm overlap
     scheduled by XLA.
 
-    Causality across chunks: rank r's queries attend fully to KV chunks from
-    ranks < r, causally to its own, not at all to ranks > r (those blocks
-    are masked by position, costing idle FLOPs on early ranks — the classic
-    ring-attention load skew; zig-zag reordering is a follow-up).
+    Causality across chunks with contiguous sharding: rank r's queries
+    attend fully to KV from ranks < r, causally to its own, not at all to
+    ranks > r — masked blocks idle early ranks (the classic ring-attention
+    load skew).  ``zigzag=True`` removes the skew: inputs must be laid out
+    by :func:`zigzag_shard` (rank r holds sequence blocks r and 2n-1-r), so
+    every rank does the same causal work per ring step; the output stays in
+    zig-zag layout (undo with :func:`zigzag_unshard` after unsharding).
     """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     lc = q.shape[1]
-    q_offset = rank * lc
+    if zigzag and lc % 2:
+        raise ValueError(f"zigzag ring needs an even local length, got {lc}")
+    pos = (lambda r: zigzag_positions(r, n, lc)) if zigzag else (
+        lambda r: r * lc + jnp.arange(lc)
+    )
+    qpos = pos(rank)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, i):
@@ -187,7 +253,7 @@ def ring_attention(
         src_rank = (rank - i) % n  # whose chunk we currently hold
         state = _block_update(
             state, q, kcur, vcur, causal=causal,
-            q_offset=q_offset, kv_offset=src_rank * lc,
+            q_positions=qpos, kv_positions=pos(src_rank),
         )
         knext = lax.ppermute(kcur, axis_name, perm)
         vnext = lax.ppermute(vcur, axis_name, perm)
@@ -201,7 +267,7 @@ def ring_attention(
         (state, k, v), _ = lax.scan(step, (state, k, v), jnp.arange(n - 1))
     state = _block_update(
         state, q, k, v, causal=causal,
-        q_offset=q_offset, kv_offset=((rank - (n - 1)) % n) * lc,
+        q_positions=qpos, kv_positions=pos((rank - (n - 1)) % n),
     )
     return _finalize(state, q.dtype)
 

@@ -7,12 +7,16 @@ scratch that persists across KV steps, and the normalized output tile is
 written once on the last step.  Causally-masked-out KV blocks are skipped
 with ``pl.when`` (no wasted MXU work past the diagonal).
 
-Backward: ``jax.custom_vjp`` whose bwd recomputes through
-:func:`horovod_tpu.parallel.attention.blockwise_attention` (O(L)-memory
-scan) — flash speed forward, checkpoint-style memory backward, no [L, L]
-materialization anywhere.
+Backward: the standard two-pass flash scheme as two more pallas kernels —
+the forward saves the per-row log-sum-exp, ``delta = rowsum(dO·O)`` is
+computed in XLA, then one kernel accumulates dK/dV over query blocks and one
+accumulates dQ over key blocks.  No [L, L] materialization anywhere, and the
+training hot path stays at MXU-kernel speed end to end.  Set
+``HVD_TPU_FLASH_BWD=blockwise`` to fall back to recomputing gradients
+through :func:`horovod_tpu.parallel.attention.blockwise_attention` (the
+cross-check oracle the tests compare against).
 
-On non-TPU backends the kernel runs in interpreter mode so the whole test
+On non-TPU backends the kernels run in interpreter mode so the whole test
 matrix exercises the same code path on the CPU mesh.
 """
 
@@ -31,7 +35,7 @@ from horovod_tpu.parallel.attention import blockwise_attention
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
                   seq_len: int):
     qi = pl.program_id(1)
@@ -79,6 +83,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, 0:1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # Per-row log-sum-exp, saved for the backward kernels.
+        lse_ref[0] = m_ref[:, 0:1] + jnp.log(l)
 
 
 def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
@@ -110,7 +116,7 @@ def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
         head = b % n_heads
         return (batch * n_kv_heads + head // n_rep, j, 0)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -119,9 +125,16 @@ def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
             pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq_pad, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max
@@ -129,37 +142,239 @@ def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v)
-    return out[:, :l]
+    return out[:, :l], lse
+
+
+def _mask_scores(causal, q_start, k_start, block_q, block_k, seq_len):
+    qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = kpos < seq_len
+    if causal:
+        mask = mask & (qpos >= kpos)
+    return mask
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     acc_ref, *, scale, causal, block_q, block_k, seq_len):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start, k_start = qi * block_q, ki * block_k
+
+    @pl.when((not causal) or (k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        mask = _mask_scores(causal, q_start, k_start, block_q, block_k, seq_len)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, scale, causal, block_q, block_k, seq_len):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, k_start = qi * block_q, ki * block_k
+
+    # Skip q blocks entirely above the causal diagonal (p would be all 0).
+    @pl.when((not causal) or (q_start + block_q - 1 >= k_start))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        mask = _mask_scores(causal, q_start, k_start, block_q, block_k, seq_len)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        # Contract the query (sublane) dim of both operands — dK/dV tiles
+        # accumulate without any materialized transpose.
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, n_heads, n_kv_heads, causal,
+                    block_q, block_k, interpret):
+    """Two-pass flash backward: dQ kernel + dK/dV kernel.
+
+    q/o/g: [B·H, L, D]; k/v: [B·KVH, L, D]; lse: [B·H, Lq_pad, 1].
+    dK/dV are computed at query-head resolution (KV tiles read through the
+    same GQA index map as the forward) and group-summed to KV heads outside.
+    """
+    bh, l, d = q.shape
+    n_rep = n_heads // n_kv_heads
+    nq = math.ceil(l / block_q)
+    nk = math.ceil(l / block_k)
+    lq_pad, lk_pad = nq * block_q, nk * block_k
+    delta = (g.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)  # [BH, L]
+    if lq_pad != l:
+        q = jnp.pad(q, ((0, 0), (0, lq_pad - l), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, lq_pad - l), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, lq_pad - l)))
+    if lk_pad != l:
+        k = jnp.pad(k, ((0, 0), (0, lk_pad - l), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, lk_pad - l), (0, 0)))
+    delta = delta[..., None]                                         # [BH, Lq, 1]
+    scale = 1.0 / math.sqrt(d)
+
+    def kv_index(b, i, j):
+        batch = b // n_heads
+        head = b % n_heads
+        return (batch * n_kv_heads + head // n_rep, j, 0)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_index,
+                           memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=l,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dK/dV: kv blocks in the second grid dim, q innermost; per-q-head
+    # output tiles indexed by the *query* head so GQA groups don't race.
+    qk_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    rk_spec = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kvk_spec = pl.BlockSpec(
+        (1, block_k, d),
+        lambda b, j, i: kv_index(b, i, j),
+        memory_space=pltpu.VMEM,
+    )
+    dkv_out_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                                memory_space=pltpu.VMEM)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=l,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[qk_spec, kvk_spec, kvk_spec, qk_spec, rk_spec, rk_spec],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    b = bh // n_heads
+    dk = dk_h.reshape(b, n_kv_heads, n_rep, lk_pad, d).sum(2)
+    dv = dv_h.reshape(b, n_kv_heads, n_rep, lk_pad, d).sum(2)
+    return (
+        dq[:, :l],
+        dk.reshape(b * n_kv_heads, lk_pad, d)[:, :l].astype(k.dtype),
+        dv.reshape(b * n_kv_heads, lk_pad, d)[:, :l].astype(v.dtype),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+    out, _ = _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
+                            causal=causal, block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
-    out = _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k)
-    return out, (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
+                              causal=causal, block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(n_heads, n_kv_heads, causal, block_q, block_k, res, g):
-    q, k, v = res
-    b = q.shape[0] // n_heads
-    l, d = q.shape[1], q.shape[2]
+    q, k, v, o, lse = res
+    import os
 
-    def ref(q, k, v):
-        # [B·H, L, D] / [B·KVH, L, D] → blockwise_attention's [B, L, H, D]
-        qb = q.reshape(b, n_heads, l, d).transpose(0, 2, 1, 3)
-        kb = k.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
-        vb = v.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
-        out = blockwise_attention(qb, kb, vb, causal=causal, block_size=block_k)
-        return out.transpose(0, 2, 1, 3).reshape(b * n_heads, l, d)
+    if os.environ.get("HVD_TPU_FLASH_BWD", "pallas").lower() == "blockwise":
+        # Cross-check oracle: recompute gradients through the XLA blockwise
+        # scan instead of the pallas kernels.
+        b = q.shape[0] // n_heads
+        l, d = q.shape[1], q.shape[2]
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+        def ref(q, k, v):
+            qb = q.reshape(b, n_heads, l, d).transpose(0, 2, 1, 3)
+            kb = k.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
+            vb = v.reshape(b, n_kv_heads, l, d).transpose(0, 2, 1, 3)
+            out = blockwise_attention(qb, kb, vb, causal=causal,
+                                      block_size=block_k)
+            return out.transpose(0, 2, 1, 3).reshape(b * n_heads, l, d)
+
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp(g)
+    interpret = jax.default_backend() != "tpu"
+    return _flash_backward(
+        q, k, v, o, lse, g, n_heads=n_heads, n_kv_heads=n_kv_heads,
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -172,8 +387,9 @@ def flash_attention(
     """Flash attention for [B, L, H, D] q and [B, L, KVH, D] k/v (GQA ok).
 
     Forward on the MXU via pallas — KV stays at KVH heads, grouped heads
-    share tiles through the BlockSpec index map.  Backward recomputes
-    blockwise (O(L) memory).  Blocks are clamped to the sequence length.
+    share tiles through the BlockSpec index map.  Backward is the two-pass
+    pallas scheme (dQ kernel + dK/dV kernel over saved log-sum-exp), O(L)
+    memory.  Blocks are clamped to the sequence length.
     """
     b, l, h, d = q.shape
     kvh = k.shape[2]

@@ -112,6 +112,20 @@ int hvdtpu_controller_stall_report(void* ctrl, uint8_t** out,
   return 0;
 }
 
+void hvdtpu_controller_enable_tick_trace(void* ctrl, int on) {
+  if (!ctrl) return;
+  static_cast<Controller*>(ctrl)->EnableTickTrace(on != 0);
+}
+
+// Drains rank-0's negotiation tick trace ("rank<SP>name\n" lines); empty on
+// other ranks or when tracing is disabled.  Free with hvdtpu_free.
+int hvdtpu_controller_drain_ticks(void* ctrl, uint8_t** out,
+                                  uint64_t* out_len) {
+  if (!ctrl) return -1;
+  *out = CopyOut(static_cast<Controller*>(ctrl)->DrainTicks(), out_len);
+  return 0;
+}
+
 void hvdtpu_free(uint8_t* p) { std::free(p); }
 
 }  // extern "C"

@@ -77,6 +77,7 @@ void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
   } else {
     e.seen[r.rank] = true;
     ++e.count;
+    if (tick_trace_enabled_) tick_events_.emplace_back(r.name, r.rank);
   }
 
   // Consistency validation against the first-seen request — the analogue
@@ -198,6 +199,20 @@ TickStatus Controller::Tick(BatchList* out) {
   *out = wire::ParseBatchList(rd);
   if (out->shutdown) shut_down_ = true;
   return out->shutdown ? TickStatus::kShutdown : TickStatus::kLive;
+}
+
+void Controller::EnableTickTrace(bool on) {
+  std::lock_guard<std::mutex> lk(table_mu_);
+  tick_trace_enabled_ = on;
+  if (!on) tick_events_.clear();
+}
+
+std::string Controller::DrainTicks() {
+  std::ostringstream os;
+  std::lock_guard<std::mutex> lk(table_mu_);
+  for (const auto& ev : tick_events_) os << ev.second << " " << ev.first << "\n";
+  tick_events_.clear();
+  return os.str();
 }
 
 std::string Controller::StallReport() {

@@ -61,6 +61,15 @@ class Controller {
   // than the warning threshold, with the missing ranks (empty if none).
   std::string StallReport();
 
+  // Per-rank negotiation tick trace (reference timeline.cc:98-132 emits an
+  // instant event on rank 0's timeline each time a rank's request for a
+  // tensor arrives).  Off by default — recording without a consumer would
+  // grow without bound; the Python engine enables it when HOROVOD_TIMELINE
+  // is configured and drains after every tick.
+  void EnableTickTrace(bool on);
+  // Drains buffered events as "rank<SP>name\n" lines (rank 0 only).
+  std::string DrainTicks();
+
  private:
   struct TableEntry {
     Request first;            // first-seen copy, the validation reference
@@ -88,6 +97,8 @@ class Controller {
   // StallReport reads it from the stall-watchdog thread.
   std::mutex table_mu_;
   std::map<std::string, TableEntry> table_;
+  bool tick_trace_enabled_ = false;           // guarded by table_mu_
+  std::vector<std::pair<std::string, int>> tick_events_;  // guarded by table_mu_
 };
 
 }  // namespace hvdtpu

@@ -152,3 +152,84 @@ def test_flash_gradient_matches_dense():
     gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_pallas_bwd_matches_blockwise_oracle(causal, monkeypatch):
+    """The two-pass pallas backward == the blockwise-recompute oracle,
+    on a GQA + tail-padded case (l=40 not divisible by the block)."""
+    q, k, v = _qkv(b=2, l=40, h=4, kvh=2, d=16, seed=3)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        return jnp.sum(out ** 2)
+
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", "pallas")
+    gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("HVD_TPU_FLASH_BWD", "blockwise")
+    gb = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+# ---------------------------------------------------------------- zig-zag
+
+
+def test_zigzag_shard_roundtrip():
+    from horovod_tpu.parallel.attention import zigzag_shard, zigzag_unshard
+
+    x = jnp.arange(2 * 48 * 3).reshape(2, 48, 3)
+    y = zigzag_unshard(zigzag_shard(x, 8), 8)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_zigzag_positions_match_shard_layout():
+    """zigzag_positions(r) must be exactly the global positions of rank r's
+    contiguous slice of a zigzag_shard-ed sequence."""
+    from horovod_tpu.parallel.attention import zigzag_positions, zigzag_shard
+
+    n, l = 4, 32
+    lc = l // n
+    pos_global = zigzag_shard(jnp.arange(l)[None, :, None], n)[0, :, 0]
+    for r in range(n):
+        got = np.asarray(zigzag_positions(r, n, lc))
+        want = np.asarray(pos_global[r * lc:(r + 1) * lc])
+        np.testing.assert_array_equal(got, want)
+
+
+def test_zigzag_balances_causal_work():
+    """Causal FLOPs per rank are equal under zig-zag and skewed without."""
+    from horovod_tpu.parallel.attention import zigzag_positions
+
+    n, lc = 8, 16
+    zz = [int((np.asarray(zigzag_positions(r, n, lc)) + 1).sum())
+          for r in range(n)]
+    contiguous = [int((np.arange(r * lc, (r + 1) * lc) + 1).sum())
+                  for r in range(n)]
+    assert len(set(zz)) == 1, f"zig-zag causal work not balanced: {zz}"
+    assert len(set(contiguous)) == n, "contiguous layout should be skewed"
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_zigzag_ring_matches_dense(causal):
+    """zigzag_shard → ring(zigzag=True) → unshard == dense on the full seq."""
+    from horovod_tpu.parallel.attention import zigzag_shard, zigzag_unshard
+
+    n = 8
+    q, k, v = _qkv(b=2, l=64, h=4, kvh=4, d=16, seed=5)
+    ref = dense_attention(q, k, v, causal=causal)
+
+    qz, kz, vz = (zigzag_shard(x, n) for x in (q, k, v))
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, axis_name="hvd", causal=causal, zigzag=True
+            ),
+            mesh=hvd.mesh(),
+            in_specs=P(None, "hvd"),
+            out_specs=P(None, "hvd"),
+            check_vma=False,
+        )
+    )
+    out = zigzag_unshard(f(qz, kz, vz), n)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -89,6 +89,66 @@ def test_engine_config_env(monkeypatch):
     assert cfg.timeline_file == "/tmp/tl.json"
 
 
+def test_timeline_negotiate_ticks_single_controller(tmp_path, monkeypatch):
+    """Engine-level timeline: the NEGOTIATE span carries a readiness tick
+    (single controller ⇒ all ranks tick at once; reference timeline.cc:98-132
+    ticks per rank)."""
+    import json
+
+    import horovod_tpu as hvd
+
+    path = tmp_path / "tl_engine.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    hvd.shutdown()
+    hvd.init()
+    try:
+        x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+        hvd.allreduce(x, name="tl.grad")
+    finally:
+        hvd.shutdown()
+        monkeypatch.delenv("HOROVOD_TIMELINE")
+        hvd.init()
+    events = json.loads(path.read_text())
+    names = [e["name"] for e in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    ticks = [e for e in events if e["name"] == "NEGOTIATE_TICK_ALL"]
+    assert ticks and all(e["ph"] == "X" for e in ticks)
+
+
+def test_timeline_negotiate_ticks_native_controller(tmp_path, monkeypatch):
+    """With the native controller, per-rank arrival ticks from the rank-0
+    message table land in the trace as NEGOTIATE_TICK_r<rank> instants."""
+    import json
+    import uuid
+
+    import horovod_tpu as hvd
+    from horovod_tpu import native
+
+    if not native.available():
+        pytest.skip("native controller unavailable")
+    path = tmp_path / "tl_native.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setenv("HOROVOD_TPU_NATIVE_CONTROLLER", "on")
+    monkeypatch.setenv(
+        "HOROVOD_TPU_CONTROLLER_TRANSPORT", f"local:{uuid.uuid4().hex}"
+    )
+    hvd.shutdown()
+    hvd.init()
+    try:
+        x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+        hvd.allreduce(x, name="tl.native.grad")
+    finally:
+        hvd.shutdown()
+        for var in ("HOROVOD_TIMELINE", "HOROVOD_TPU_NATIVE_CONTROLLER",
+                    "HOROVOD_TPU_CONTROLLER_TRANSPORT"):
+            monkeypatch.delenv(var)
+        hvd.init()
+    events = json.loads(path.read_text())
+    ticks = [e for e in events if e["name"].startswith("NEGOTIATE_TICK_r")]
+    assert ticks, "no per-rank negotiation ticks in the trace"
+    assert {e["name"] for e in ticks} == {"NEGOTIATE_TICK_r0"}  # 1-process world
+
+
 def test_timeline_writes_chrome_trace(tmp_path):
     """Timeline output is valid Chrome-trace JSON with tensor pids
     (reference timeline.cc:24-188, docs/timeline.md)."""

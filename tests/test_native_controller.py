@@ -191,6 +191,34 @@ def test_uint32_supported_on_the_wire():
     assert run_ranks(2, body)[0][0].error == ""
 
 
+def test_tick_trace_records_per_rank_arrivals():
+    """Rank 0's tick trace records each rank's request arrival — the data
+    behind the timeline's per-rank NEGOTIATE tick events
+    (reference timeline.cc:98-132)."""
+
+    def body(rank, ctrl):
+        if rank == 0:
+            ctrl.enable_tick_trace()
+        ctrl.submit(AR, "float32", "tt.a", (4,))
+        drain(ctrl, 1)
+        return ctrl.drain_ticks()
+
+    results = run_ranks(3, body)
+    assert sorted(r for _, r in results[0]) == [0, 1, 2]
+    assert all(n == "tt.a" for n, _ in results[0])
+    assert results[1] == [] and results[2] == []  # rank-0-only data
+
+
+def test_tick_trace_disabled_by_default():
+    def body(rank, ctrl):
+        ctrl.submit(AR, "float32", "tt.b", (4,))
+        drain(ctrl, 1)
+        return ctrl.drain_ticks()
+
+    results = run_ranks(2, body)
+    assert results[0] == [] and results[1] == []
+
+
 def test_stall_report_names_missing_ranks():
     """Rank 0's table reports tensors stuck waiting on specific ranks
     (reference CheckForStalledTensors, operations.cc:1424-1470)."""
