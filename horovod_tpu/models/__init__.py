@@ -4,5 +4,6 @@ VGG) plus the Llama-3 flagship for the transformer-era baseline configs."""
 from horovod_tpu.models.mnist import MnistConvNet, MnistMLP  # noqa: F401
 from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152  # noqa: F401
 from horovod_tpu.models.vgg import VGG16  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models import llama  # noqa: F401
 from horovod_tpu.models import moe  # noqa: F401

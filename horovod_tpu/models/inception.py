@@ -1,0 +1,210 @@
+"""Inception V3 — the reference's other headline benchmark model
+(reference: README.md:51-57 and docs/benchmarks.md:1-7 publish ~90% scaling
+efficiency for Inception V3 on 512 GPUs; the model itself comes from the
+external tf_cnn_benchmarks suite, so this is a from-scratch TPU-first
+implementation of the standard architecture, not a port).
+
+Same conventions as :mod:`horovod_tpu.models.resnet`: NHWC layout, bf16
+compute / f32 params via ``dtype``, optional cross-replica BatchNorm via
+``bn_axis_name``.  The auxiliary classifier head is included behind
+``aux_logits`` (returned as a second output in train mode) since the
+canonical training recipe weights it 0.4; throughput benchmarks can leave
+it off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """conv → BN → relu, the Inception building block."""
+
+    filters: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str | tuple = "SAME"
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         axis_name=self.bn_axis_name)(x)
+        return nn.relu(x)
+
+
+def _pool_avg(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = cbn(64, (1, 1))(x, train)
+        b5 = cbn(48, (1, 1))(x, train)
+        b5 = cbn(64, (5, 5))(b5, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        bp = cbn(self.pool_features, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b3 = cbn(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        bd = cbn(64, (1, 1))(x, train)
+        bd = cbn(96, (3, 3))(bd, train)
+        bd = cbn(96, (3, 3), strides=(2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7×7 (1×7 then 7×1) branches."""
+
+    channels_7x7: int
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        c7 = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        bd = cbn(c7, (1, 1))(x, train)
+        bd = cbn(c7, (7, 1))(bd, train)
+        bd = cbn(c7, (1, 7))(bd, train)
+        bd = cbn(c7, (7, 1))(bd, train)
+        bd = cbn(192, (1, 7))(bd, train)
+        bp = cbn(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b3 = cbn(192, (1, 1))(x, train)
+        b3 = cbn(320, (3, 3), strides=(2, 2), padding="VALID")(b3, train)
+        b7 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(192, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        b7 = cbn(192, (3, 3), strides=(2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks (split 3×3 into 1×3 ‖ 3×1)."""
+
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        b1 = cbn(320, (1, 1))(x, train)
+        b3 = cbn(384, (1, 1))(x, train)
+        b3 = jnp.concatenate(
+            [cbn(384, (1, 3))(b3, train), cbn(384, (3, 1))(b3, train)], axis=-1
+        )
+        bd = cbn(448, (1, 1))(x, train)
+        bd = cbn(384, (3, 3))(bd, train)
+        bd = jnp.concatenate(
+            [cbn(384, (1, 3))(bd, train), cbn(384, (3, 1))(bd, train)], axis=-1
+        )
+        bp = cbn(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = cbn(128, (1, 1))(x, train)
+        x = cbn(768, (5, 5), padding="VALID")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+class InceptionV3(nn.Module):
+    """Standard Inception V3 (299×299 canonical; any H,W ≥ 75 works).
+
+    Returns logits, or ``(logits, aux_logits)`` when ``aux_logits=True`` and
+    ``train=True``.
+    """
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        blk = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = x.astype(self.dtype)
+        # Stem: 299 → 35×35×192
+        x = cbn(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35×35
+        x = InceptionA(pool_features=32, **blk)(x, train)
+        x = InceptionA(pool_features=64, **blk)(x, train)
+        x = InceptionA(pool_features=64, **blk)(x, train)
+        x = InceptionB(**blk)(x, train)
+        # 17×17
+        x = InceptionC(channels_7x7=128, **blk)(x, train)
+        x = InceptionC(channels_7x7=160, **blk)(x, train)
+        x = InceptionC(channels_7x7=160, **blk)(x, train)
+        x = InceptionC(channels_7x7=192, **blk)(x, train)
+        aux = None
+        if self.aux_logits and train:
+            aux = InceptionAux(self.num_classes, **blk)(x, train)
+        x = InceptionD(**blk)(x, train)
+        # 8×8
+        x = InceptionE(**blk)(x, train)
+        x = InceptionE(**blk)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x).astype(jnp.float32)
+        if aux is not None:
+            return logits, aux
+        return logits

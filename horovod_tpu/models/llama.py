@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -254,3 +254,173 @@ def num_params(cfg: LlamaConfig) -> int:
     kdim = cfg.n_kv_heads * cfg.head_dim
     per_layer = 2 * d + d * d * 2 + 2 * d * kdim + 3 * d * f
     return v * d * 2 + L * per_layer + d
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive decoding with a KV cache (inference path).
+#
+# The reference's inference story is "load the checkpoint, run it in one
+# process" (its docs/inference.md); for a transformer that means prefill +
+# cached decode.  TPU-first shape: the cache is a static [n_layers, B,
+# max_len, KVH, Dh] buffer updated with dynamic_update_slice, the decode
+# step is one scanned layer block (same stacked-params layout as forward),
+# and generation is a lax.scan over steps — one compiled program, no
+# per-token retracing.
+# ---------------------------------------------------------------------------
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value buffers: k/v [n_layers, B, max_len, KVH, Dh];
+    ``length`` is the number of filled positions (scalar int32)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def prefill(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """Run the prompt through the model, filling cache[:, :, :L].
+
+    Returns (last-position logits [B, V], updated cache).  Uses the same
+    stacked-layer scan as :func:`forward`; attention is the configured
+    engine (the flash kernel applies here — prefill is the MXU-bound
+    phase).
+    """
+    b, l = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"][tokens].astype(dt)
+    positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+    cos, sin = rope_tables(cfg, positions)
+
+    def layer(x, lp):
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = _attention(cfg, q, k, v, positions_offset=0, sp_axis=None)
+        x = x + o.reshape(b, l, cfg.dim) @ lp["wo"].astype(dt)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    cache = KVCache(
+        k=lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0)),
+        v=lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0)),
+        length=jnp.asarray(l, jnp.int32),
+    )
+    return logits, cache
+
+
+def decode_step(
+    params: dict, token: jax.Array, cfg: LlamaConfig, cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """One autoregressive step: ``token`` [B] → logits [B, V] + cache.
+
+    Attends over the cached keys/values (masked past ``length``); the new
+    position's K/V are written at index ``length``.  Decode is
+    matvec-bound, so attention is a plain masked einsum in f32 — no kernel
+    needed.
+    """
+    b = token.shape[0]
+    dt = cfg.dtype
+    max_len = cache.k.shape[2]
+    pos = cache.length                                    # scalar int32
+    x = params["embed"][token][:, None, :].astype(dt)     # [B, 1, D]
+    cos, sin = rope_tables(cfg, jnp.broadcast_to(pos, (b, 1)))
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    # mask over cache positions: attend to [0, pos] inclusive
+    valid = jnp.arange(max_len) <= pos                    # [max_len]
+
+    def layer(x, inputs):
+        lp, kc, vc = inputs                               # kc/vc [B, M, KVH, Dh]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        # GQA: [B, M, KVH, Dh] → [B, M, H, Dh] via repeat on the head axis
+        kr = jnp.repeat(kc, n_rep, axis=2) if n_rep > 1 else kc
+        vr = jnp.repeat(vc, n_rep, axis=2) if n_rep > 1 else vc
+        s = jnp.einsum(
+            "bqhd,bmhd->bhqm", q.astype(jnp.float32), kr.astype(jnp.float32)
+        ) * scale                                         # [B, H, 1, M]
+        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqm,bmhd->bqhd", p, vr.astype(jnp.float32))
+        x = x + o.astype(dt).reshape(b, 1, cfg.dim) @ lp["wo"].astype(dt)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (kc, vc)
+
+    x, (ks, vs) = lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, KVCache(k=ks, v=vs, length=pos + 1)
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int,
+    max_len: int | None = None,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy (or sampled) generation: prompt [B, L] → [B, max_new_tokens].
+
+    One prefill + one ``lax.scan`` of cached decode steps; jit-friendly
+    end to end (static shapes, no per-token retracing).
+    """
+    b, l = prompt.shape
+    max_len = max_len or (l + max_new_tokens)
+    if max_len < l + max_new_tokens:
+        raise ValueError(
+            f"max_len={max_len} < prompt {l} + max_new_tokens {max_new_tokens}"
+        )
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt, cfg, cache)
+    if key is None:
+        key = jax.random.key(0)
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1
+        ).astype(prompt.dtype)
+
+    def step(carry, k):
+        logits, cache = carry
+        tok = pick(logits, k)
+        logits, cache = decode_step(params, tok, cfg, cache)
+        return (logits, cache), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), toks = lax.scan(step, (logits, cache), keys)
+    return jnp.moveaxis(toks, 0, 1)                       # [B, T]

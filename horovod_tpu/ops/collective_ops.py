@@ -99,6 +99,11 @@ def allreduce(
         op = Average if average else Sum
     if op in (Min, Max, Product):
         return _reduce(tensor, op, axis_name)
+    quantized = getattr(compression, "quantized_allreduce", None)
+    if callable(quantized):
+        # Wire-format compressors (int8) replace the collective itself:
+        # quantized all_gather + local dequant-sum instead of psum.
+        return quantized(tensor, average=op is Average, axis_name=axis_name)
     compressed, ctx = compression.compress(tensor)
     reduced = _reduce(compressed, op, axis_name)
     return compression.decompress(reduced, ctx)

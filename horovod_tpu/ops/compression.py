@@ -133,6 +133,62 @@ class TopKCompressor:
         return dense.reshape(tensor.shape)
 
 
+class Int8Compressor(Compressor):
+    """8-bit quantized all-reduce (a TPU-native extension in the fork's
+    gradient-compression spirit, reference horovod/torch/__init__.py:46-83).
+
+    **Per-block** max-abs scaling to int8 (round-to-nearest, 1024-element
+    blocks), then the *collective itself* changes: an int8 ``all_gather``
+    moves ~(n-1)/n·S/4 bytes per link on a ring versus ~2·S·(n-1)/n for an
+    fp32 all-reduce — an ~8× wire saving — and every rank dequantizes and
+    sums locally in fp32, so no int8 overflow can occur.  Block-granular
+    scales matter because the fusion path concatenates many tensors into one
+    buffer before compressing (ops/fusion.py): one global scale would let a
+    large-magnitude layer zero out a small-magnitude one; with blocks, each
+    element's quantization step is bounded by its own 1024-neighborhood's
+    max-abs (error ≤ size · block_maxabs/254 per element).
+
+    Like :class:`TopKCompressor` this cannot be used on the plain dense
+    path; :func:`collective_ops.allreduce` dispatches to
+    :meth:`quantized_allreduce` automatically.
+    """
+
+    BLOCK = 1024
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError(
+            "Int8Compressor changes the collective; pass it to allreduce() "
+            "(compression=Compression.int8), which dispatches automatically."
+        )
+
+    decompress = compress
+
+    @classmethod
+    def quantized_allreduce(cls, tensor: jax.Array, *, average: bool = False,
+                            axis_name="hvd") -> jax.Array:
+        orig_dtype, orig_shape = tensor.dtype, tensor.shape
+        flat = tensor.astype(jnp.float32).reshape(-1)
+        n = flat.shape[0]
+        nblocks = -(-n // cls.BLOCK)
+        pad = nblocks * cls.BLOCK - n
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        x = flat.reshape(nblocks, cls.BLOCK)
+        scale = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
+        scale = jnp.maximum(scale, 1e-30)          # all-zero block guard
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        all_q = lax.all_gather(q, axis_name)       # [size, nb, B] int8 wire
+        all_s = lax.all_gather(scale, axis_name)   # [size, nb, 1] f32
+        summed = jnp.sum(all_q.astype(jnp.float32) * all_s, axis=0)
+        if average:
+            summed = summed / all_q.shape[0]   # works for tuple axis_names too
+        out = summed.reshape(-1)
+        if pad:
+            out = out[:n]
+        return out.reshape(orig_shape).astype(orig_dtype)
+
+
 class Compression:
     """Registry, parity with reference compression.py:70-74."""
 
@@ -140,3 +196,4 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     topk = TopKCompressor
+    int8 = Int8Compressor

@@ -590,13 +590,14 @@ def allreduce_async(
         op = Average if average else Sum
     eng = _engine()
     t = _as_rank_major(tensor, "allreduce")
-    h = eng.handles.allocate()
+    name = name or _auto_name("allreduce")
+    h = eng.handles.allocate(name)
     eng.enqueue(
         _PendingOp(
             kind="allreduce",
             handle=h,
             tensor=t,
-            name=name or _auto_name("allreduce"),
+            name=name,
             op=op,
             compression=compression,
             group_id=group_id,
@@ -623,13 +624,14 @@ def sparse_allreduce_async(
     scatter-add in one program."""
     eng = _engine()
     t = _as_rank_major(tensor, "sparse_allreduce")
-    h = eng.handles.allocate()
+    name = name or _auto_name("sparse_allreduce")
+    h = eng.handles.allocate(name)
     eng.enqueue(
         _PendingOp(
             kind="sparse",
             handle=h,
             tensor=t,
-            name=name or _auto_name("sparse_allreduce"),
+            name=name,
             op=Average if average else Sum,
             topk=TopKCompressor(ratio=ratio, k=k),
         )
@@ -678,13 +680,14 @@ def allgather_async(tensors, name: str | None = None) -> int:
             sizes = None
     else:
         t = _as_rank_major(tensors, "allgather")
-    h = eng.handles.allocate()
+    name = name or _auto_name("allgather")
+    h = eng.handles.allocate(name)
     eng.enqueue(
         _PendingOp(
             kind="allgather",
             handle=h,
             tensor=t,
-            name=name or _auto_name("allgather"),
+            name=name,
             sizes=sizes,
         )
     )
@@ -702,13 +705,14 @@ def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
     t = _as_rank_major(tensor, "broadcast")
     if not 0 <= root_rank < basics.size():
         raise ValueError(f"root_rank {root_rank} outside [0, {basics.size()})")
-    h = eng.handles.allocate()
+    name = name or _auto_name("broadcast")
+    h = eng.handles.allocate(name)
     eng.enqueue(
         _PendingOp(
             kind="broadcast",
             handle=h,
             tensor=t,
-            name=name or _auto_name("broadcast"),
+            name=name,
             root_rank=root_rank,
         )
     )
@@ -730,6 +734,23 @@ def synchronize(handle: int):
     """Block until the op completes; returns its output
     (reference torch/mpi_ops.py:422-438)."""
     eng = _engine()
+    if eng.timeline is not None:
+        tname = eng.handles.name(handle)
+        if tname is not None:
+            # Flush BEFORE opening the span so this tensor's own
+            # NEGOTIATE-end / DISPATCH / op events precede it; the span is
+            # an async event (matched by handle id, not the B/E stack), so
+            # a concurrent cycle-thread dispatch cannot mis-nest it either.
+            eng.flush()
+            eng.timeline.async_start(
+                tname, timeline_mod.WAIT_FOR_OUTPUT, handle
+            )
+            try:
+                return eng.handles.wait(handle, lambda: None)
+            finally:
+                eng.timeline.async_end(
+                    tname, timeline_mod.WAIT_FOR_OUTPUT, handle
+                )
     return eng.handles.wait(handle, eng.flush)
 
 

@@ -27,6 +27,7 @@ class _Entry:
     result: Any = None           # jax.Array once dispatched
     error: BaseException | None = None
     dispatched: bool = False
+    name: str | None = None      # tensor name, for timeline attribution
 
 
 class HandleManager:
@@ -35,12 +36,17 @@ class HandleManager:
         self._counter = itertools.count()
         self._entries: dict[int, _Entry] = {}
 
-    def allocate(self) -> int:
+    def allocate(self, name: str | None = None) -> int:
         """reference handle_manager.cc:22-27."""
         h = next(self._counter)
         with self._lock:
-            self._entries[h] = _Entry()
+            self._entries[h] = _Entry(name=name)
         return h
+
+    def name(self, handle: int) -> str | None:
+        with self._lock:
+            e = self._entries.get(handle)
+            return e.name if e is not None else None
 
     def _get(self, handle: int) -> _Entry:
         with self._lock:

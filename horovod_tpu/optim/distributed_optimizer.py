@@ -82,6 +82,7 @@ def DistributedOptimizer(
     is_sparse: bool = False,
     sparse_ratio: float = 0.01,
     local: bool = False,
+    backward_passes_per_step: int = 1,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-averaged gradients.
 
@@ -90,11 +91,15 @@ def DistributedOptimizer(
     ================  =========================================================
     reference                         here
     ================  =========================================================
-    ``compression``    ``compression=`` (none / fp16 / bf16)
+    ``compression``    ``compression=`` (none / fp16 / bf16 / int8)
     ``sparse_as_dense``  not needed — JAX gradients are dense pytrees
     fork ``is_sparse``   ``is_sparse=True`` + ``sparse_ratio`` (top-k path)
     fork ``self.local``  ``local=True`` skips communication entirely
     ``device_dense`` …  owned by XLA (no device staging knobs on TPU)
+    ``backward_passes_per_step``  same name: accumulate k local steps, then
+                       one fused allreduce + update (optax.MultiSteps around
+                       the reducing transform, so the collective only runs
+                       on the flush step — reference torch/__init__.py:115)
     ================  =========================================================
 
     Must run inside SPMD code where ``axis_name`` is bound (shard_map/pjit
@@ -119,7 +124,21 @@ def DistributedOptimizer(
             reduced = grads
         return optimizer.update(reduced, state, params, **extra)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    tx = optax.GradientTransformation(init_fn, update_fn)
+    if backward_passes_per_step > 1:
+        # Accumulation OUTSIDE the reducing transform: k local micro-grads
+        # accumulate with no communication, and the allreduce inside
+        # update_fn runs once per k steps on the accumulated gradient.
+        # optax.MultiSteps keeps a running MEAN; the reference's autograd
+        # hooks accumulate .grad by SUM over the k backward passes
+        # (torch/__init__.py:115-165), so scale by k to match — a ported
+        # script keeps its learning-rate behavior.
+        k = float(backward_passes_per_step)
+        summed = optax.chain(optax.scale(k), tx)
+        return optax.MultiSteps(
+            summed, every_k_schedule=backward_passes_per_step
+        ).gradient_transformation()
+    return tx
 
 
 class TrainStepResult(NamedTuple):

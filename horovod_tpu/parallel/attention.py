@@ -177,43 +177,44 @@ def zigzag_positions(rank, n: int, local_len: int) -> jax.Array:
     return jnp.concatenate([head, tail])
 
 
-def zigzag_shard(x: jax.Array, n: int, *, axis: int = 1) -> jax.Array:
-    """Reorder a global sequence axis so that *contiguous* sharding over an
-    ``n``-way mesh axis hands each rank its zig-zag block pair.
+def _zigzag_order(n: int) -> list[int]:
+    """Block layout of the zig-zag shard: ``0, 2n-1, 1, 2n-2, …, n-1, n`` —
+    slice r of a contiguous shard over n ranks is blocks ``(r, 2n-1-r)``.
+    The single source of truth for :func:`zigzag_shard`/``unshard`` and
+    consistent with :func:`zigzag_positions` (tested against each other)."""
+    order: list[int] = []
+    for r in range(n):
+        order.extend([r, 2 * n - 1 - r])
+    return order
 
-    View the sequence as ``2n`` blocks ``[0..2n)``; the output lays them out
-    as ``0, 2n-1, 1, 2n-2, ..., n-1, n`` so slice r of the contiguous shard
-    is blocks ``(r, 2n-1-r)``.  Inverse: :func:`zigzag_unshard`.
-    """
+
+def _permute_blocks(x: jax.Array, n: int, axis: int, perm: list[int]) -> jax.Array:
     l = x.shape[axis]
     if l % (2 * n):
         raise ValueError(f"sequence length {l} not divisible by 2n={2 * n}")
     block = l // (2 * n)
-    order = []
-    for r in range(n):
-        order.extend([r, 2 * n - 1 - r])
     xs = jnp.moveaxis(x, axis, 0).reshape(2 * n, block, *[
         s for i, s in enumerate(x.shape) if i != axis
     ])
-    xs = xs[jnp.asarray(order)]
+    xs = xs[jnp.asarray(perm)]
     return jnp.moveaxis(xs.reshape(l, *xs.shape[2:]), 0, axis)
+
+
+def zigzag_shard(x: jax.Array, n: int, *, axis: int = 1) -> jax.Array:
+    """Reorder a global sequence axis so that *contiguous* sharding over an
+    ``n``-way mesh axis hands each rank its zig-zag block pair (see
+    :func:`_zigzag_order`).  Inverse: :func:`zigzag_unshard`.
+    """
+    return _permute_blocks(x, n, axis, _zigzag_order(n))
 
 
 def zigzag_unshard(x: jax.Array, n: int, *, axis: int = 1) -> jax.Array:
     """Inverse permutation of :func:`zigzag_shard`."""
-    l = x.shape[axis]
-    block = l // (2 * n)
-    order = []
-    for r in range(n):
-        order.extend([r, 2 * n - 1 - r])
-    inverse = [0] * (2 * n)
+    order = _zigzag_order(n)
+    inverse = [0] * len(order)
     for pos, blk in enumerate(order):
         inverse[blk] = pos
-    xs = jnp.moveaxis(x, axis, 0).reshape(2 * n, block, *[
-        s for i, s in enumerate(x.shape) if i != axis
-    ])
-    xs = xs[jnp.asarray(inverse)]
-    return jnp.moveaxis(xs.reshape(l, *xs.shape[2:]), 0, axis)
+    return _permute_blocks(x, n, axis, inverse)
 
 
 def ring_attention(

@@ -333,8 +333,8 @@ def _flash_backward(q, k, v, o, lse, g, *, n_heads, n_kv_heads, causal,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k, bwd_impl):
     interpret = jax.default_backend() != "tpu"
     out, _ = _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
                             causal=causal, block_q=block_q, block_k=block_k,
@@ -342,7 +342,8 @@ def _flash(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
     return out
 
 
-def _flash_fwd(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
+def _flash_fwd(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k,
+               bwd_impl):
     interpret = jax.default_backend() != "tpu"
     out, lse = _flash_forward(q, k, v, n_heads=n_heads, n_kv_heads=n_kv_heads,
                               causal=causal, block_q=block_q, block_k=block_k,
@@ -350,11 +351,10 @@ def _flash_fwd(q, k, v, n_heads, n_kv_heads, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(n_heads, n_kv_heads, causal, block_q, block_k, res, g):
+def _flash_bwd(n_heads, n_kv_heads, causal, block_q, block_k, bwd_impl,
+               res, g):
     q, k, v, o, lse = res
-    import os
-
-    if os.environ.get("HVD_TPU_FLASH_BWD", "pallas").lower() == "blockwise":
+    if bwd_impl == "blockwise":
         # Cross-check oracle: recompute gradients through the XLA blockwise
         # scan instead of the pallas kernels.
         b = q.shape[0] // n_heads
@@ -382,7 +382,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
-    block_q: int = 512, block_k: int = 512,
+    block_q: int = 512, block_k: int = 512, bwd: str | None = None,
 ) -> jax.Array:
     """Flash attention for [B, L, H, D] q and [B, L, KVH, D] k/v (GQA ok).
 
@@ -390,7 +390,19 @@ def flash_attention(
     share tiles through the BlockSpec index map.  Backward is the two-pass
     pallas scheme (dQ kernel + dK/dV kernel over saved log-sum-exp), O(L)
     memory.  Blocks are clamped to the sequence length.
+
+    ``bwd``: ``"pallas"`` (default) or ``"blockwise"`` — the cross-check
+    oracle that recomputes gradients through the XLA blockwise scan.  The
+    choice is resolved at TRACE time (``HVD_TPU_FLASH_BWD`` env var when
+    ``bwd`` is None); under jit it is baked into the compiled program, so
+    switching an existing step function requires rebuilding it (fresh jit)
+    or passing ``bwd=`` explicitly.
     """
+    import os
+
+    bwd_impl = (bwd or os.environ.get("HVD_TPU_FLASH_BWD", "pallas")).lower()
+    if bwd_impl not in ("pallas", "blockwise"):
+        raise ValueError(f"bwd must be 'pallas' or 'blockwise', got {bwd!r}")
     b, l, h, d = q.shape
     kvh = k.shape[2]
     block_q = min(block_q, max(l, 1))
@@ -399,5 +411,5 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, l, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
-    out = _flash(qt, kt, vt, h, kvh, causal, block_q, block_k)
+    out = _flash(qt, kt, vt, h, kvh, causal, block_q, block_k, bwd_impl)
     return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)

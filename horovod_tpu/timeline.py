@@ -9,13 +9,17 @@ its own "process" (pid) whose track shows the phases of its collective:
       reference timeline.cc:98-132 — time between enqueue and the engine
       deciding to run the op (here: time in the fusion queue until the cycle
       flush picks the tensor up).
-  ALLREDUCE / ALLGATHER / BROADCAST  top-level op span
-  QUEUE / FUSE / DISPATCH / WAIT_FOR_OUTPUT
+  NEGOTIATE_TICK_r<k> / NEGOTIATE_TICK_ALL
+      per-rank readiness instants inside the NEGOTIATE span (reference
+      timeline.cc:98-132; single-controller jobs see all ranks at once).
+  ALLREDUCE / ALLGATHER / BROADCAST  top-level op span (``fused_with: N``
+      annotates tensor-fusion grouping)
+  DISPATCH / WAIT_FOR_OUTPUT
       TPU-native activity vocabulary replacing the reference's
       MEMCPY_IN_FUSION_BUFFER / NCCL_ALLREDUCE etc. (operations.h:29-46):
       XLA owns the memcpys and the wire, so what the host can observe is
-      queue time, fusion grouping, dispatch (trace/compile/launch) and the
-      wait on the device future.
+      dispatch (trace/compile/launch) and the wait on the device future
+      in ``synchronize``.
 
 Device-side detail (per-HLO timing, ICI traffic) belongs to the JAX/XLA
 profiler; :func:`trace_annotation` bridges engine phases into it so both
@@ -33,8 +37,6 @@ from typing import TextIO
 import jax
 
 NEGOTIATE = "NEGOTIATE"
-QUEUE = "QUEUE"
-FUSE = "FUSE"
 DISPATCH = "DISPATCH"
 WAIT_FOR_OUTPUT = "WAIT_FOR_OUTPUT"
 
@@ -122,6 +124,29 @@ class Timeline:
                 return
             self._emit(
                 {"name": activity, "ph": "X", "ts": self._ts_us(), "dur": 0,
+                 "pid": self._pid(tensor_name), "tid": 0}
+            )
+
+    def async_start(self, tensor_name: str, activity: str, aid: int) -> None:
+        """Begin an *async* span (Chrome ph 'b'): unlike B/E duration events
+        these are matched by id, not the per-(pid,tid) stack, so spans that
+        overlap other activities on the same track cannot mis-nest."""
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "b", "cat": activity,
+                 "id": aid, "ts": self._ts_us(),
+                 "pid": self._pid(tensor_name), "tid": 0}
+            )
+
+    def async_end(self, tensor_name: str, activity: str, aid: int) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "e", "cat": activity,
+                 "id": aid, "ts": self._ts_us(),
                  "pid": self._pid(tensor_name), "tid": 0}
             )
 

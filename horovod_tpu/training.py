@@ -22,6 +22,56 @@ from horovod_tpu.callbacks import Callback
 from horovod_tpu.optim.distributed_optimizer import make_train_step
 
 
+def make_eval_step(
+    metric_fn: Callable[[Any, Any], dict],
+    *,
+    mesh=None,
+    axis_name: str = basics.AXIS_NAME,
+) -> Callable[[Any, Any], dict]:
+    """Compile a distributed evaluation step.
+
+    ``metric_fn(params, batch) -> {name: scalar}`` computes per-shard
+    metrics; the returned function takes replicated ``params`` and a
+    rank-major ``batch`` and returns the metrics averaged over the mesh —
+    the compiled per-batch analogue of ``MetricAverageCallback``
+    (reference horovod/_keras/callbacks.py:33-67 allreduces epoch metrics).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import collective_ops
+    from horovod_tpu.ops.collective_ops import Average
+
+    if mesh is None:
+        mesh = basics.mesh()
+
+    def step(params, batch):
+        metrics = metric_fn(params, batch)
+        return {
+            k: collective_ops.allreduce(
+                jnp.asarray(v), op=Average, axis_name=axis_name
+            )
+            for k, v in metrics.items()
+        }
+
+    jitted = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(axis_name)), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    if jax.default_backend() != "cpu":
+        return jitted
+
+    def throttled(params, batch):
+        # CPU-simulation: cap in-flight collective launches at 1 (see
+        # make_train_step's comment on the in-process rendezvous limit).
+        out = jitted(params, batch)
+        jax.block_until_ready(out)
+        return out
+
+    return throttled
+
+
 def fit(
     params: Any,
     optimizer: optax.GradientTransformation,

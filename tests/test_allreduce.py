@@ -2,6 +2,7 @@
 reference test/test_tensorflow.py:56-120 and test/test_torch.py sync/average/
 fused tests, on the 8-device CPU mesh."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -66,6 +67,79 @@ def test_allreduce_compressed_roundtrip(comp):
     )
     # 16-bit wire tolerance: bf16 ulp at |36| is 0.25 (8-bit mantissa).
     np.testing.assert_allclose(np.asarray(out), expected, atol=0.35)
+
+
+def test_allreduce_int8_quantized():
+    """int8 wire: error bounded by size · maxabs/254 per element, dtype and
+    shape preserved (TPU-native extension of the fork's compression set)."""
+    n = hvd.size()
+    x = hvd.per_rank(
+        lambda r: jnp.linspace(-1.0, 1.0, 64).astype(jnp.float32) * (r + 1)
+    )
+    out = hvd.allreduce(x, average=False, compression=hvd.Compression.int8)
+    assert out.dtype == jnp.float32 and out.shape == (64,)
+    expected = np.sum(
+        [np.linspace(-1, 1, 64) * (r + 1) for r in range(n)], axis=0
+    )
+    # per-rank scale = maxabs/127 = (r+1)/127; worst case half a step each
+    bound = sum((r + 1) / 127.0 / 2 for r in range(n)) + 1e-6
+    np.testing.assert_allclose(np.asarray(out), expected, atol=bound)
+
+
+def test_allreduce_int8_average_and_exact_levels():
+    """Values already on the int8 grid survive exactly; average divides."""
+    n = hvd.size()
+    # each rank contributes k/127 * maxabs with maxabs=1 → exact grid points
+    x = hvd.per_rank(
+        lambda r: jnp.asarray([0.0, 1.0 / 127, 64.0 / 127, 1.0], jnp.float32)
+    )
+    out = hvd.allreduce(x, average=True, compression=hvd.Compression.int8)
+    np.testing.assert_allclose(
+        np.asarray(out), [0.0, 1.0 / 127, 64.0 / 127, 1.0], atol=1e-6
+    )
+    zero = hvd.allreduce(
+        hvd.per_rank(lambda r: jnp.zeros((8,), jnp.float32)),
+        average=False, compression=hvd.Compression.int8,
+    )
+    np.testing.assert_array_equal(np.asarray(zero), np.zeros(8))
+
+
+def test_allreduce_int8_dense_path_raises():
+    with pytest.raises(NotImplementedError, match="changes the collective"):
+        hvd.Compression.int8.compress(jnp.ones((4,)))
+
+
+def test_int8_fused_bucket_preserves_small_tensors():
+    """Per-block scaling: a tiny-magnitude gradient fused into one bucket
+    with a large one must NOT quantize to zero (grouped/fused path, the
+    DistributedOptimizer route)."""
+    from horovod_tpu.ops.compression import Int8Compressor
+    from horovod_tpu.optim.distributed_optimizer import allreduce_gradients
+    from jax.sharding import PartitionSpec as P
+
+    n = hvd.size()
+    blk = Int8Compressor.BLOCK
+    big = jnp.full((blk,), 1000.0, jnp.float32)
+    small = jnp.full((blk,), 1e-4, jnp.float32)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda g: allreduce_gradients(
+                g, axis_name="hvd", compression=hvd.Compression.int8
+            ),
+            mesh=hvd.mesh(),
+            in_specs=({"big": P(), "small": P()},),
+            out_specs={"big": P(), "small": P()},
+            check_vma=False,
+        )
+    )
+    out = f({"big": big, "small": small})
+    # average of n identical contributions == the input, within block error
+    np.testing.assert_allclose(np.asarray(out["big"]), 1000.0, rtol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(out["small"]), 1e-4, rtol=1e-2,
+        err_msg="small-magnitude tensor was zeroed by a shared bucket scale",
+    )
 
 
 def test_allreduce_async_poll_synchronize():

@@ -243,3 +243,20 @@ class TestFit:
         assert len(seen) == 3
         assert seen[0] == pytest.approx(0.01)
         assert seen[-1] == pytest.approx(0.01 * hvd.size())
+
+
+def test_make_eval_step_averages_metrics():
+    """Compiled eval step: per-shard metrics come back mesh-averaged
+    (the per-batch analogue of MetricAverageCallback)."""
+    n = hvd.size()
+
+    def metric_fn(params, batch):
+        # per-rank "accuracy" = the rank's own constant slice value
+        return {"acc": jnp.mean(batch), "twice": 2.0 * jnp.mean(batch)}
+
+    step = hvd.make_eval_step(metric_fn)
+    batch = hvd.per_rank(lambda r: jnp.full((2, 3), float(r)))
+    out = step({}, batch)
+    expected = np.mean(np.arange(n))
+    np.testing.assert_allclose(float(out["acc"]), expected, rtol=1e-6)
+    np.testing.assert_allclose(float(out["twice"]), 2 * expected, rtol=1e-6)

@@ -46,6 +46,28 @@ def test_resnet_train_step_updates_batchstats():
     assert "batch_stats" in mutated
 
 
+def test_inception_v3_forward_param_count():
+    """Inception V3 at canonical 299×299: ~23.8M params (torchvision's
+    no-aux count ≈ 23.83M) and correct logits shape; aux head adds a second
+    output in train mode."""
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=1000)
+    x = jnp.ones((1, 299, 299, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
+    assert 22e6 < n_params < 25e6, n_params
+
+    aux_model = InceptionV3(num_classes=10, aux_logits=True)
+    v2 = aux_model.init(jax.random.PRNGKey(0), x, train=True)
+    (logits, aux), _ = aux_model.apply(
+        v2, x, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (1, 10) and aux.shape == (1, 10)
+
+
 def test_vgg16_forward_param_count():
     model = VGG16(num_classes=100)
     x = jnp.ones((2, 32, 32, 3))
@@ -131,6 +153,45 @@ def test_llama_ring_sp_matches_dense():
     )
     out = f(params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_llama_kv_cache_decode_matches_forward():
+    """Cached autoregressive decode == recomputing the full forward at
+    every step (greedy tokens identical, logits close)."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    prompt = jnp.array([[5, 17, 42], [7, 7, 9]], jnp.int32)
+    n_new = 5
+
+    out = jax.jit(
+        lambda p, t: llama.generate(p, t, cfg, max_new_tokens=n_new)
+    )(params, prompt)
+    assert out.shape == (2, n_new)
+
+    # oracle: re-run the whole (uncached) forward per step, argmax last pos
+    toks = prompt
+    for _ in range(n_new):
+        logits = llama.forward(params, toks, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks[:, 3:]))
+
+
+def test_llama_prefill_logits_match_forward():
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(4))
+    tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+    cache = llama.init_cache(cfg, 1, 8)
+    logits, cache = llama.prefill(params, tokens, cfg, cache)
+    full = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1]), atol=2e-5
+    )
+    assert int(cache.length) == 4
 
 
 def test_llama_tp_partition_specs_compile():

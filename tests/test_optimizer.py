@@ -171,3 +171,44 @@ def test_broadcast_optimizer_state_scalars():
 
 def test_broadcast_object_single_host():
     assert hvd.broadcast_object({"resume_epoch": 7}) == {"resume_epoch": 7}
+
+
+def test_train_step_cpu_backend_throttles_dispatch_depth():
+    """Pin the CPU-simulation deadlock defense: on the cpu backend
+    make_train_step must return the blocking wrapper (XLA's in-process CPU
+    collectives abort their rendezvous when many launches are in flight;
+    see distributed_optimizer.py).  On TPU the raw jitted step is returned —
+    this test documents the contract so a refactor cannot silently drop the
+    throttle and resurface the 40s rendezvous hang."""
+    assert jax.default_backend() == "cpu"  # the whole suite runs CPU-sim
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    step = hvd.make_train_step(_loss_fn, tx, donate=False)
+    assert step.__name__ == "throttled"
+    assert not hasattr(step, "lower")  # plain function, not jax.jit wrapper
+
+
+def test_backward_passes_per_step_accumulates():
+    """k=2: first micro-step leaves params untouched, second applies the
+    SUM of both accumulated gradients — the reference's autograd hooks
+    accumulate .grad over k backward passes (torch/__init__.py:115-165)."""
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=2)
+    params = {"w": jnp.zeros((4,)), "b": jnp.zeros(())}
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(_loss_fn, tx, donate=False)
+
+    x = hvd.per_rank(lambda r: jnp.ones((2, 4)))
+    y_at = lambda c: hvd.per_rank(lambda r: jnp.full((2,), c))
+    out1 = step(params, opt_state, (x, y_at(2.0)))
+    np.testing.assert_allclose(np.asarray(out1.params["w"]), 0.0)  # held
+    out2 = step(out1.params, out1.opt_state, (x, y_at(6.0)))
+    assert not np.allclose(np.asarray(out2.params["w"]), 0.0)      # applied
+
+    # Loss is quadratic with identical x, so grad(y=2)+grad(y=6) equals
+    # 2·grad(y=4): the sum-accumulated update must match one plain step at
+    # doubled learning rate on the mean target.
+    ref_tx = hvd.DistributedOptimizer(optax.sgd(0.2))
+    ref_step = hvd.make_train_step(_loss_fn, ref_tx, donate=False)
+    ref = ref_step(params, ref_tx.init(params), (x, y_at(4.0)))
+    np.testing.assert_allclose(
+        np.asarray(out2.params["w"]), np.asarray(ref.params["w"]), rtol=1e-6
+    )
