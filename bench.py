@@ -82,7 +82,7 @@ def _init_backend() -> str:
     broken TPU plugin must degrade to a CPU number, not crash before the
     JSON line is emitted.
     """
-    probe_s = float(os.environ.get("HVD_TPU_BENCH_PROBE_TIMEOUT", "240"))
+    probe_s = float(os.environ.get("HVD_TPU_BENCH_PROBE_TIMEOUT", "120"))
     if not _probe_tpu(probe_s):
         os.environ["JAX_PLATFORMS"] = "cpu"
         try:
@@ -334,6 +334,8 @@ def main() -> None:
         "n_chips": hvd.size(),
         "resnet101_flops_per_step_per_chip": result["flops_per_step"],
     }
+    if not on_tpu and os.environ.get("JAX_PLATFORMS") == "cpu":
+        extras["tpu_unavailable_fell_back_to_cpu"] = True
     # Optional sub-benchmarks, each fenced by the remaining time budget so
     # the primary JSON line is never lost to a driver timeout.
     for fn in (_bench_llama, _bench_fusion):

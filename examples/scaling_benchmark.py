@@ -16,7 +16,7 @@ absolute numbers are meaningless but the harness exercises the identical
 program path end to end.
 
 Usage:
-    python examples/scaling_benchmark.py [--model resnet50|mlp] [--bs 32]
+    python examples/scaling_benchmark.py [--model resnet50|inception|mlp] [--bs 32]
 """
 
 from __future__ import annotations
@@ -107,7 +107,11 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     bs = args.bs or (32 if on_tpu else 2)
-    img = args.img or (224 if on_tpu else 32)
+    if args.model == "inception":
+        # Inception V3's stride-2 VALID reductions need H,W >= 75.
+        img = args.img or (299 if on_tpu else 128)
+    else:
+        img = args.img or (224 if on_tpu else 32)
 
     devices = jax.devices()
     sizes = [n for n in (1, 2, 4, 8, 16, 32, 64, 128) if n <= len(devices)]

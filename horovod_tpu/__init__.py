@@ -94,6 +94,7 @@ from horovod_tpu.checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer  # noqa: F401
+from horovod_tpu.optim.zero import ZeroStepResult, make_zero_train_step  # noqa: F401
 from horovod_tpu.training import fit, make_eval_step  # noqa: F401
 from horovod_tpu.data import ShardedLoader, shard_indices  # noqa: F401
 from horovod_tpu import ops  # noqa: F401

@@ -129,14 +129,13 @@ def DistributedOptimizer(
         # Accumulation OUTSIDE the reducing transform: k local micro-grads
         # accumulate with no communication, and the allreduce inside
         # update_fn runs once per k steps on the accumulated gradient.
-        # optax.MultiSteps keeps a running MEAN; the reference's autograd
-        # hooks accumulate .grad by SUM over the k backward passes
-        # (torch/__init__.py:115-165), so scale by k to match — a ported
-        # script keeps its learning-rate behavior.
-        k = float(backward_passes_per_step)
-        summed = optax.chain(optax.scale(k), tx)
+        # use_grad_mean=False: accumulate by SUM, matching the reference's
+        # autograd hooks which add into .grad over the k backward passes
+        # (torch/__init__.py:115-165) — a ported script keeps its
+        # learning-rate behavior.
         return optax.MultiSteps(
-            summed, every_k_schedule=backward_passes_per_step
+            tx, every_k_schedule=backward_passes_per_step,
+            use_grad_mean=False,
         ).gradient_transformation()
     return tx
 

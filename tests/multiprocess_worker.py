@@ -93,7 +93,34 @@ def main() -> None:
         val = np.asarray(jax.device_get(hvd.synchronize(hh)))
         assert np.allclose(val.reshape(-1, 3), sum(range(n))), (nm, val)
 
+    # --- controller-negotiated FUSION across processes: a caller-delimited
+    # group must fuse into one dispatched batch on both processes (the
+    # fusion decision is made by rank 0's controller, so it is identical
+    # everywhere — the multi-host fusion-safety claim of eager.py).
+    gs = [
+        hvd.from_per_rank([np.full((5,), float(r + i), np.float32)
+                           for r in range(n)])
+        for i in range(3)
+    ]
+    outs = hvd.grouped_allreduce_eager(
+        gs, average=False, names=[f"mp.f{i}" for i in range(3)]
+    )
+    for i, o in enumerate(outs):
+        want = sum(r + i for r in range(n))
+        got = np.asarray(jax.device_get(o)).reshape(-1, 5)
+        assert np.allclose(got, want), (i, got, want)
+
     hvd.shutdown()
+
+    # --- per-rank NEGOTIATE ticks (reference timeline.cc:98-132): rank 0's
+    # trace must show arrivals from BOTH processes.
+    tl_path = os.environ.get("HOROVOD_TIMELINE")
+    if tl_path and me == 0:
+        events = json.load(open(tl_path))
+        ticks = {e["name"] for e in events
+                 if e["name"].startswith("NEGOTIATE_TICK_r")}
+        assert {"NEGOTIATE_TICK_r0", "NEGOTIATE_TICK_r1"} <= ticks, ticks
+
     print("WORKER_OK " + json.dumps({"rank": me, "size": n}), flush=True)
 
 

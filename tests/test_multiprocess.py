@@ -29,7 +29,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_end_to_end():
+def test_two_process_end_to_end(tmp_path):
     nproc = 2
     coord_port = _free_port()
     ctrl_port = _free_port()
@@ -45,6 +45,8 @@ def test_two_process_end_to_end():
             HOROVOD_TPU_PROCESS_ID=str(pid),
             HOROVOD_TPU_NATIVE_CONTROLLER="on",
             HOROVOD_TPU_CONTROLLER_TRANSPORT=f"tcp:127.0.0.1:{ctrl_port}",
+            # rank 0 writes the timeline; the worker asserts per-rank ticks
+            HOROVOD_TIMELINE=str(tmp_path / "mp_timeline.json"),
         )
         procs.append(
             subprocess.Popen(

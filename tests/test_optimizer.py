@@ -212,3 +212,37 @@ def test_backward_passes_per_step_accumulates():
     np.testing.assert_allclose(
         np.asarray(out2.params["w"]), np.asarray(ref.params["w"]), rtol=1e-6
     )
+
+
+def test_zero_step_matches_replicated_adam():
+    """ZeRO sharded step == replicated DistributedOptimizer step (Adam is
+    elementwise), with optimizer state at 1/n per rank."""
+    n = hvd.size()
+    params = {"w": jnp.arange(10.0) / 10, "b": jnp.ones((3,))}
+
+    zstep, zinit = hvd.make_zero_train_step(_loss_fn_quad, optax.adam(0.1))
+    zstate = zinit(params)
+    # array leaves shard: global leading dim = n * ceil(13/n)
+    mu = jax.tree.leaves(zstate)[1]
+    assert mu.shape[0] == n * (-(-13 // n))
+
+    rtx = hvd.DistributedOptimizer(optax.adam(0.1))
+    rstep = hvd.make_train_step(_loss_fn_quad, rtx, donate=False)
+    rstate = rtx.init(params)
+
+    batch = hvd.per_rank(lambda r: jnp.full((2, 1), float(r + 1)))
+    zp, zs, zl = params, zstate, None
+    rp, rs = params, rstate
+    for _ in range(3):
+        zout = zstep(zp, zs, batch)
+        zp, zs, zl = zout.params, zout.opt_state, zout.loss
+        rout = rstep(rp, rs, batch)
+        rp, rs = rout.params, rout.opt_state
+        np.testing.assert_allclose(float(zl), float(rout.loss), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(zp), jax.tree.leaves(rp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def _loss_fn_quad(params, batch):
+    scale = jnp.mean(batch)
+    return scale * (jnp.sum(params["w"] ** 2) + jnp.sum(params["b"] ** 2))
