@@ -1,0 +1,160 @@
+"""``python -m horovod_tpu.launch`` — the multi-process launcher.
+
+The reference launches with plain ``mpirun -np N python train.py``
+(reference docs/running.md; no custom launcher).  On TPU there is no MPI;
+this is the torchrun-shaped equivalent for the cases that need one process
+per host (or per simulated worker): it spawns N copies of the script with
+the coordination environment set, prefixes their output by rank, and
+propagates the first failure.
+
+    # 2-process CPU simulation of a 2-host job, eager TCP control plane:
+    python -m horovod_tpu.launch --nproc 2 -- python train.py --epochs 1
+
+On a real pod slice you usually do NOT need this: one process per host is
+started by the platform (GKE/queued resources), and ``hvd.init()`` reads
+``HOROVOD_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` which the platform or
+this launcher sets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(rank: int, pipe, out) -> None:
+    for line in iter(pipe.readline, ""):
+        out.write(f"[rank {rank}] {line}")
+        out.flush()
+    pipe.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.launch",
+        description="Spawn N coordinated worker processes on this host.",
+    )
+    p.add_argument("--nproc", type=int, required=True,
+                   help="worker processes on THIS host")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="total hosts in the job (world = nnodes * nproc)")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this host's index in [0, nnodes)")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (default: 127.0.0.1:auto; "
+                        "REQUIRED when nnodes > 1 — every host must name "
+                        "node 0's address)")
+    p.add_argument("--controller-transport", default=None,
+                   help="native control plane, e.g. tcp:<node0>:9876 "
+                        "(default: tcp on an auto local port; REQUIRED when "
+                        "nnodes > 1)")
+    p.add_argument("--cpu", action="store_true",
+                   help="pin workers to the CPU backend (simulation)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- command to run (e.g. -- python train.py)")
+    args = p.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given; usage: ... --nproc 2 -- python train.py")
+    if not 0 <= args.node_rank < args.nnodes:
+        p.error(f"--node-rank {args.node_rank} outside [0, {args.nnodes})")
+    if args.nnodes > 1 and not (args.coordinator and args.controller_transport):
+        p.error(
+            "nnodes > 1 requires explicit --coordinator and "
+            "--controller-transport (auto-picked local ports would differ "
+            "per host)"
+        )
+
+    world = args.nnodes * args.nproc
+    coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
+    transport = args.controller_transport or f"tcp:127.0.0.1:{_free_port()}"
+
+    procs: list[subprocess.Popen] = []
+    streams: list[threading.Thread] = []
+    for i in range(args.nproc):
+        pid = args.node_rank * args.nproc + i
+        env = dict(os.environ)
+        env.update(
+            HOROVOD_TPU_COORDINATOR=coordinator,
+            HOROVOD_TPU_NUM_PROCESSES=str(world),
+            HOROVOD_TPU_PROCESS_ID=str(pid),
+            HOROVOD_TPU_CONTROLLER_TRANSPORT=transport,
+        )
+        if args.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        procs.append(proc)
+        t = threading.Thread(
+            target=_stream, args=(pid, proc.stdout, sys.stdout), daemon=True
+        )
+        t.start()
+        streams.append(t)
+
+    rc = 0
+    try:
+        # Gang semantics (mpirun/torchrun): the first worker failure tears
+        # the rest down — survivors would otherwise block forever inside a
+        # collective waiting for the dead rank.
+        import time as _time
+
+        live = set(range(len(procs)))
+        while live:
+            for i in sorted(live):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                live.discard(i)
+                if code != 0 and rc == 0:
+                    rc = code
+                    print(
+                        f"horovod_tpu.launch: worker {i} exited rc={code}; "
+                        "terminating the remaining workers",
+                        file=sys.stderr,
+                    )
+                    for j in live:
+                        if procs[j].poll() is None:
+                            procs[j].terminate()
+            if live:
+                _time.sleep(0.2)
+    except KeyboardInterrupt:
+        rc = 130
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for t in streams:
+            t.join(timeout=5)
+    if rc:
+        failed = [i for i, pr in enumerate(procs) if pr.returncode]
+        print(f"horovod_tpu.launch: worker(s) {failed} failed (rc={rc})",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

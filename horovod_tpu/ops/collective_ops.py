@@ -170,9 +170,10 @@ def broadcast(
     XLA pattern-matches into an efficient ICI broadcast.  Works for every
     dtype (bool/int via bitcast-free select on zeros).
     """
+    # lax.axis_index natively combines tuple axes row-major, so the
+    # hierarchical (dcn, ici) form needs no special case: ranks follow the
+    # mesh's device order.
     idx = lax.axis_index(axis_name)
-    if isinstance(axis_name, (tuple, list)):
-        raise ValueError("broadcast over multiple axes: pass one axis at a time")
     mask = idx == root_rank
     if jnp.issubdtype(tensor.dtype, jnp.bool_):
         as_int = jnp.where(mask, tensor.astype(jnp.int8), jnp.zeros_like(tensor, jnp.int8))

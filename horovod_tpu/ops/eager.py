@@ -86,6 +86,33 @@ class EagerEngine:
         self.config = cfg
         self.handles = HandleManager()
         self.timeline = timeline
+        self._axis: Any = AXIS_NAME
+        if cfg.hierarchical_allreduce:
+            # HOROVOD_HIERARCHICAL_ALLREDUCE: dispatch over a 2-D
+            # (dcn, ici) mesh so XLA nests the reduction — fast ICI within
+            # the local group, DCN across groups (the reference's
+            # ReduceScatter→cross-MPI→AllGather pipeline,
+            # operations.cc:1070-1223, expressed as mesh structure).
+            local = cfg.hierarchy_local_size or jax.local_device_count()
+            total = int(mesh.devices.size)
+            if local > 1 and total % local == 0 and total // local > 1:
+                from jax.sharding import Mesh
+
+                self.mesh = Mesh(
+                    mesh.devices.reshape(total // local, local),
+                    ("dcn", "ici"),
+                )
+                self._axis = ("dcn", "ici")
+            else:
+                print(
+                    "WARNING: HOROVOD_HIERARCHICAL_ALLREDUCE=1 ignored: "
+                    f"world of {total} devices does not factor into "
+                    f"(cross, local={local}) groups with both extents > 1; "
+                    "dispatching over the flat 1-D mesh.  Set "
+                    "HOROVOD_TPU_HIERARCHY_LOCAL_SIZE to a divisor of the "
+                    "world size to choose the inner extent.",
+                    file=sys.stderr,
+                )
         self._lock = threading.Lock()
         self._flush_lock = threading.Lock()
         self._queue: list[_PendingOp] = []
@@ -422,7 +449,7 @@ class EagerEngine:
             shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=P(AXIS_NAME),
+                in_specs=P(self._axis),
                 out_specs=out_specs,
                 check_vma=False,
             )
@@ -440,7 +467,7 @@ class EagerEngine:
                 flats = [x.reshape(-1) for x in xs]
                 buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
                 red = collective_ops.allreduce(
-                    buf, op=op, axis_name=AXIS_NAME, compression=compression
+                    buf, op=op, axis_name=self._axis, compression=compression
                 )
                 outs, off = [], 0
                 for x in xs:
@@ -487,7 +514,7 @@ class EagerEngine:
 
                     def bc(x):
                         return collective_ops.broadcast(
-                            x[0], root, axis_name=AXIS_NAME
+                            x[0], root, axis_name=self._axis
                         )
 
                     fn = self._shard_map(bc)
@@ -498,7 +525,7 @@ class EagerEngine:
                 if fn is None:
 
                     def ag(x):
-                        return lax.all_gather(x[0], AXIS_NAME, tiled=True)
+                        return lax.all_gather(x[0], self._axis, tiled=True)
 
                     fn = self._shard_map(ag)
                     self._dispatch_cache["ag"] = fn
@@ -521,7 +548,7 @@ class EagerEngine:
 
                     def sp(x):
                         return topk.sparse_allreduce(
-                            x[0], average=avg, axis_name=AXIS_NAME
+                            x[0], average=avg, axis_name=self._axis
                         )
 
                     fn = self._shard_map(sp)

@@ -68,6 +68,11 @@ class EngineConfig:
     stall_check_enabled: bool = True
     stall_warning_time_s: float = DEFAULT_STALL_WARNING_TIME_S
     hierarchical_allreduce: bool = False
+    # Inner (ici) extent of the hierarchical dispatch mesh; None → this
+    # process's local device count (the reference's local/cross comm split
+    # by MPI_COMM_TYPE_SHARED, operations.cc:1558-1590).  Settable for
+    # tests via HOROVOD_TPU_HIERARCHY_LOCAL_SIZE.
+    hierarchy_local_size: int | None = None
     sparse_allreduce: bool = False
     # Native coordination engine (native/src/): "auto" enables it for
     # multi-controller jobs when libhvdtpu builds; "on" forces it (tests,
@@ -90,6 +95,9 @@ class EngineConfig:
                 "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME_S
             ),
             hierarchical_allreduce=_get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchy_local_size=(
+                _get_int("HOROVOD_TPU_HIERARCHY_LOCAL_SIZE", 0) or None
+            ),
             sparse_allreduce=_get_bool(HOROVOD_SPARSE_ALLREDUCE),
             native_controller=os.environ.get(
                 "HOROVOD_TPU_NATIVE_CONTROLLER", "auto"

@@ -171,3 +171,50 @@ def test_timeline_writes_chrome_trace(tmp_path):
     assert "ALLREDUCE" in names
     meta = next(e for e in events if e["name"] == "process_name")
     assert meta["args"]["name"] == "grad/w1"
+
+
+def test_hierarchical_allreduce_engine(monkeypatch):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE=1: the engine dispatches over a 2-D
+    (dcn, ici) mesh (reference operations.cc:1070-1223's two-level
+    reduction as mesh structure) with identical results for every op."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_TPU_HIERARCHY_LOCAL_SIZE", "2")
+    hvd.shutdown()
+    hvd.init()
+    try:
+        from horovod_tpu.ops import eager as eager_mod
+
+        n = hvd.size()
+        eng = eager_mod._engine()
+        assert eng._axis == ("dcn", "ici")
+        assert eng.mesh.axis_names == ("dcn", "ici")
+        assert eng.mesh.devices.shape == (n // 2, 2)
+
+        x = hvd.per_rank(lambda r: jnp.arange(4.0) + r)
+        out = hvd.allreduce(x, average=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.arange(4.0) + (n - 1) / 2
+        )
+        b = hvd.broadcast(hvd.per_rank(lambda r: jnp.full((2,), float(r))), 3)
+        np.testing.assert_allclose(np.asarray(b), 3.0)
+        g = hvd.allgather(hvd.per_rank(lambda r: jnp.full((1,), float(r))))
+        np.testing.assert_allclose(np.asarray(g), np.arange(float(n)))
+        sp = hvd.sparse_allreduce(
+            hvd.per_rank(lambda r: jnp.arange(8.0)), ratio=1.0
+        )
+        np.testing.assert_allclose(np.asarray(sp), np.arange(8.0) * n)
+        outs = hvd.grouped_allreduce_eager(
+            [hvd.per_rank(lambda r: jnp.ones((3,)) * i) for i in range(3)],
+            average=False,
+        )
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(np.asarray(o), float(i * n))
+    finally:
+        hvd.shutdown()
+        monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE")
+        monkeypatch.delenv("HOROVOD_TPU_HIERARCHY_LOCAL_SIZE")
+        hvd.init()

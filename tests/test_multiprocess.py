@@ -81,3 +81,55 @@ def test_two_process_end_to_end(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed (rc={p.returncode}):\n{out}"
         assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
+def test_launcher_module_runs_two_workers():
+    """python -m horovod_tpu.launch --nproc 2 --cpu -- <worker>: the
+    reference's ``mpirun -np 2`` launch story (docs/running.md there)."""
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable, WORKER],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("WORKER_OK") == 2, r.stdout
+    assert "[rank 0]" in r.stdout and "[rank 1]" in r.stdout
+
+
+def test_launcher_gang_teardown_on_failure(tmp_path):
+    """One crashed worker must bring the gang down promptly (survivors
+    would otherwise block in a collective forever)."""
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text(
+        "import os, sys, time\n"
+        "if os.environ['HOROVOD_TPU_PROCESS_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(300)\n"  # survivor blocks; launcher must kill it
+    )
+    import time as _t
+    t0 = _t.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable, str(bad)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(HERE),
+    )
+    took = _t.monotonic() - t0
+    assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+    assert took < 60, f"gang teardown took {took:.0f}s"
+    assert "terminating the remaining workers" in r.stderr
+
+
+def test_launcher_rejects_bad_multihost_flags():
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--nnodes", "2", "--", "true"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 2
+    assert "--coordinator" in r.stderr
