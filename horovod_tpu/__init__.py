@@ -92,6 +92,7 @@ from horovod_tpu.checkpoint import (  # noqa: F401
     load_model,
     restore_checkpoint,
     save_checkpoint,
+    wait_for_checkpoints,
 )
 from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer  # noqa: F401
 from horovod_tpu.optim.zero import ZeroStepResult, make_zero_train_step  # noqa: F401

@@ -34,18 +34,51 @@ def _ckpt(path: str):
     return ocp.PyTreeCheckpointer(), os.path.abspath(path)
 
 
-def save_checkpoint(path: str, state: Any, *, step: int | None = None) -> str | None:
+_async_checkpointer = None
+
+
+def _async_ckpt():
+    global _async_checkpointer
+    if _async_checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _async_checkpointer = ocp.AsyncCheckpointer(
+            ocp.PyTreeCheckpointHandler()
+        )
+    return _async_checkpointer
+
+
+def save_checkpoint(
+    path: str, state: Any, *, step: int | None = None,
+    async_save: bool = False,
+) -> str | None:
     """Write a checkpoint from rank 0 only (the reference convention:
     ``if hvd.rank() == 0: saver.save(...)``).  Returns the path written, or
-    None on non-root processes."""
+    None on non-root processes.
+
+    ``async_save=True`` returns as soon as the device→host copy is done and
+    writes in a background thread (orbax AsyncCheckpointer) so training
+    continues during the disk write; call :func:`wait_for_checkpoints`
+    before reading the file or exiting.
+    """
     basics._require_init()
     if basics.cross_rank() != 0:
         return None
-    checkpointer, base = _ckpt(path)
+    base = os.path.abspath(path)
     target = os.path.join(base, f"step_{step}") if step is not None else base
+    if async_save:
+        _async_ckpt().save(target, jax.device_get(state), force=True)
+        return target
+    checkpointer, _ = _ckpt(path)
     state = jax.device_get(state)
     checkpointer.save(target, state, force=True)
     return target
+
+
+def wait_for_checkpoints() -> None:
+    """Block until all pending :func:`save_checkpoint` async writes land."""
+    if _async_checkpointer is not None:
+        _async_checkpointer.wait_until_finished()
 
 
 def latest_checkpoint(path: str) -> str | None:

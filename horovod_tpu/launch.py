@@ -69,6 +69,8 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         p.error("no command given; usage: ... --nproc 2 -- python train.py")
+    if args.nproc < 1:
+        p.error(f"--nproc must be >= 1, got {args.nproc}")
     if not 0 <= args.node_rank < args.nnodes:
         p.error(f"--node-rank {args.node_rank} outside [0, {args.nnodes})")
     if args.nnodes > 1 and not (args.coordinator and args.controller_transport):
@@ -108,30 +110,40 @@ def main(argv: list[str] | None = None) -> int:
         streams.append(t)
 
     rc = 0
+    first_failed = None
     try:
         # Gang semantics (mpirun/torchrun): the first worker failure tears
         # the rest down — survivors would otherwise block forever inside a
-        # collective waiting for the dead rank.
+        # collective waiting for the dead rank.  terminate() escalates to
+        # kill() after a grace period for workers that trap SIGTERM.
         import time as _time
 
         live = set(range(len(procs)))
+        terminated_at = None
         while live:
             for i in sorted(live):
                 code = procs[i].poll()
                 if code is None:
                     continue
                 live.discard(i)
-                if code != 0 and rc == 0:
-                    rc = code
+                if code != 0 and rc == 0 and terminated_at is None:
+                    rc, first_failed = code, i
                     print(
                         f"horovod_tpu.launch: worker {i} exited rc={code}; "
                         "terminating the remaining workers",
                         file=sys.stderr,
                     )
+                    terminated_at = _time.monotonic()
                     for j in live:
                         if procs[j].poll() is None:
                             procs[j].terminate()
             if live:
+                if (terminated_at is not None
+                        and _time.monotonic() - terminated_at > 15.0):
+                    for j in live:
+                        if procs[j].poll() is None:
+                            procs[j].kill()
+                    terminated_at = float("inf")  # escalate once
                 _time.sleep(0.2)
     except KeyboardInterrupt:
         rc = 130
@@ -150,9 +162,14 @@ def main(argv: list[str] | None = None) -> int:
         for t in streams:
             t.join(timeout=5)
     if rc:
-        failed = [i for i, pr in enumerate(procs) if pr.returncode]
-        print(f"horovod_tpu.launch: worker(s) {failed} failed (rc={rc})",
-              file=sys.stderr)
+        # Report only genuine failures — not survivors the launcher itself
+        # SIGTERM/SIGKILLed (negative returncode) or never waited on.
+        failed = [i for i, pr in enumerate(procs)
+                  if pr.returncode is not None and pr.returncode > 0]
+        if first_failed is not None and first_failed not in failed:
+            failed.append(first_failed)
+        print(f"horovod_tpu.launch: worker(s) {sorted(failed)} failed "
+              f"(rc={rc})", file=sys.stderr)
     return rc
 
 

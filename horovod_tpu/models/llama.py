@@ -49,7 +49,7 @@ class LlamaConfig:
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32     # master weights
-    attn_impl: str = "dense"           # dense | blockwise | ring | ulysses | flash
+    attn_impl: str = "dense"  # dense | blockwise | ring | ulysses | ulysses_flash | flash
     attn_block_size: int = 512
     remat: bool = True                 # jax.checkpoint each scanned layer
 
@@ -177,8 +177,17 @@ def _attention(cfg: LlamaConfig, q, k, v, *, positions_offset, sp_axis):
         )
     if impl == "ring":
         return attn_mod.ring_attention(q, k, v, axis_name=sp_axis, causal=True)
-    if impl == "ulysses":
-        return attn_mod.ulysses_attention(q, k, v, axis_name=sp_axis, causal=True)
+    if impl in ("ulysses", "ulysses_flash"):
+        local = None
+        if impl == "ulysses_flash":
+            # Sequence-parallel a2a re-shard + the pallas kernel as the
+            # local engine: the long-context fast path.
+            from horovod_tpu.parallel.flash_attention import flash_attention
+
+            local = flash_attention
+        return attn_mod.ulysses_attention(
+            q, k, v, axis_name=sp_axis, causal=True, impl=local
+        )
     if impl == "flash":
         from horovod_tpu.parallel.flash_attention import flash_attention
 

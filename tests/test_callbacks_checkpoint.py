@@ -145,3 +145,19 @@ def test_load_model_rewraps_optimizer(tmp_path):
     )
     g = hvd.per_rank(lambda r: jnp.full(3, float(r)))
     np.testing.assert_allclose(np.asarray(f(g)), -0.1 * 3.5, rtol=1e-6)
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_save returns immediately; wait_for_checkpoints flushes, and the
+    restore round-trips the state."""
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.asarray(42)}
+    target = hvd.save_checkpoint(str(tmp_path / "ck"), state, step=1,
+                                 async_save=True)
+    hvd.wait_for_checkpoints()
+    assert target is not None
+    found = hvd.latest_checkpoint(str(tmp_path / "ck"))
+    assert found and found.endswith("step_1")
+    restored = hvd.restore_checkpoint(found)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert int(np.asarray(restored["step"])) == 42

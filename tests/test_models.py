@@ -155,6 +155,36 @@ def test_llama_ring_sp_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+def test_llama_ulysses_flash_sp_matches_dense():
+    """Sequence-parallel Llama via all-to-all + the pallas flash kernel as
+    the local engine (attn_impl='ulysses_flash') == dense."""
+    cfg_u = llama.llama_tiny(dtype=jnp.float32, attn_impl="ulysses_flash",
+                             n_heads=8, n_kv_heads=8)
+    cfg_d = llama.llama_tiny(dtype=jnp.float32, attn_impl="dense",
+                             n_heads=8, n_kv_heads=8)
+    params = llama.init_params(cfg_d, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg_d.vocab_size)
+    ref = llama.forward(params, tokens, cfg_d)
+    lc = 64 // 8
+
+    def shard_fwd(params, tokens):
+        r = jax.lax.axis_index("hvd")
+        return llama.forward(params, tokens, cfg_u,
+                             positions_offset=r * lc, sp_axis="hvd")
+
+    f = jax.jit(
+        jax.shard_map(
+            shard_fwd, mesh=hvd.mesh(),
+            in_specs=(P(), P(None, "hvd")),
+            out_specs=P(None, "hvd"),
+            check_vma=False,
+        )
+    )
+    out = f(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
 def test_llama_kv_cache_decode_matches_forward():
     """Cached autoregressive decode == recomputing the full forward at
     every step (greedy tokens identical, logits close)."""

@@ -133,3 +133,38 @@ def test_launcher_rejects_bad_multihost_flags():
     )
     assert r.returncode == 2
     assert "--coordinator" in r.stderr
+
+
+def test_launcher_escalates_to_kill_for_sigterm_trappers(tmp_path):
+    """A survivor that traps SIGTERM must still be brought down (term→kill
+    escalation after the grace period)."""
+    bad = tmp_path / "trap_worker.py"
+    bad.write_text(
+        "import os, signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "if os.environ['HOROVOD_TPU_PROCESS_ID'] == '1':\n"
+        "    time.sleep(1); sys.exit(5)\n"
+        "time.sleep(300)\n"
+    )
+    import time as _t
+    t0 = _t.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable, str(bad)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(HERE),
+    )
+    took = _t.monotonic() - t0
+    assert r.returncode == 5, (r.returncode, r.stderr)
+    assert took < 60, f"term->kill escalation took {took:.0f}s"
+    assert "worker(s) [1] failed" in r.stderr
+
+
+def test_launcher_rejects_nproc_zero():
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "0",
+         "--", "true"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 2 and "--nproc" in r.stderr
