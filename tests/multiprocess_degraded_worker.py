@@ -1,0 +1,64 @@
+"""Worker for the DEGRADED multi-process eager mode: multi-host world with
+NO controller transport configured (HOROVOD_TPU_NATIVE_CONTROLLER=auto).
+The engine must warn and fall back to Python coordination, where only
+caller-delimited fusion groups fuse — and those must still produce correct,
+deadlock-free results because the group boundaries are identical on every
+process (eager.py's cross-host safety claim for the degraded mode)."""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager as eager_mod
+
+    hvd.init()
+    n = hvd.size()
+    me = jax.process_index()
+
+    eng = eager_mod._engine()
+    assert eng.controller is None, (
+        "degraded mode expected NO native controller (no transport set)"
+    )
+
+    # Caller-delimited groups: identical boundaries on every process.
+    for round_i in range(3):
+        gs = [
+            hvd.from_per_rank(
+                [np.full((4,), float(r + i + round_i), np.float32)
+                 for r in range(n)]
+            )
+            for i in range(4)
+        ]
+        outs = hvd.grouped_allreduce_eager(
+            gs, average=False, names=[f"dg.{round_i}.{i}" for i in range(4)]
+        )
+        for i, o in enumerate(outs):
+            want = sum(r + i + round_i for r in range(n))
+            got = np.asarray(jax.device_get(o)).reshape(-1, 4)
+            assert np.allclose(got, want), (round_i, i, got, want)
+
+    # Plain named allreduces (solo groups) must also work degraded.
+    out = hvd.allreduce(
+        hvd.from_per_rank([np.arange(3.0, dtype=np.float32) + r
+                           for r in range(n)]),
+        average=True, name="dg.single",
+    )
+    got = np.asarray(jax.device_get(out)).reshape(-1, 3)
+    assert np.allclose(got, np.arange(3.0) + (n - 1) / 2), got
+
+    hvd.shutdown()
+    print("DEGRADED_OK " + json.dumps({"rank": me}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,40 +28,36 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_end_to_end(tmp_path):
-    nproc = 2
+def _run_workers(worker: str, nproc: int, env_overrides: dict,
+                 *, drop: tuple[str, ...] = (), timeout: int = 300):
+    """Spawn ``nproc`` copies of ``worker`` with the coordination env set;
+    on timeout, kill survivors and fail with the captured output.  Returns
+    the per-worker outputs after asserting rc == 0."""
     coord_port = _free_port()
-    ctrl_port = _free_port()
-
     procs = []
     for pid in range(nproc):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # each worker drives ONE cpu device
+        for var in drop:
+            env.pop(var, None)
         env.update(
             JAX_PLATFORMS="cpu",
             HOROVOD_TPU_COORDINATOR=f"127.0.0.1:{coord_port}",
             HOROVOD_TPU_NUM_PROCESSES=str(nproc),
             HOROVOD_TPU_PROCESS_ID=str(pid),
-            HOROVOD_TPU_NATIVE_CONTROLLER="on",
-            HOROVOD_TPU_CONTROLLER_TRANSPORT=f"tcp:127.0.0.1:{ctrl_port}",
-            # rank 0 writes the timeline; the worker asserts per-rank ticks
-            HOROVOD_TIMELINE=str(tmp_path / "mp_timeline.json"),
         )
+        env.update(env_overrides)
         procs.append(
             subprocess.Popen(
-                [sys.executable, WORKER],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
+                [sys.executable, worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             )
         )
 
     outs: list[str | None] = [None] * nproc
     try:
         for i, p in enumerate(procs):
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             outs[i] = out
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -80,7 +76,45 @@ def test_two_process_end_to_end(tmp_path):
 
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed (rc={p.returncode}):\n{out}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_end_to_end(tmp_path):
+    outs = _run_workers(
+        WORKER, 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+            # rank 0 writes the timeline; the worker asserts per-rank ticks
+            "HOROVOD_TIMELINE": str(tmp_path / "mp_timeline.json"),
+        },
+    )
+    for i, out in enumerate(outs):
         assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
+def test_two_process_degraded_python_coordination():
+    """Multi-host eager WITHOUT a controller transport: the engine must
+    warn, fall back to Python coordination, and caller-delimited fusion
+    groups must stay correct and deadlock-free across real processes
+    (the degraded mode's cross-host safety claim in eager.py)."""
+    from horovod_tpu import native
+
+    if not native.available():
+        pytest.skip("libhvdtpu.so unavailable — the fallback under test "
+                    "is the no-transport one, not native-unavailability")
+    outs = _run_workers(
+        os.path.join(HERE, "multiprocess_degraded_worker.py"), 2,
+        {"HOROVOD_TPU_NATIVE_CONTROLLER": "auto"},
+        drop=("HOROVOD_TPU_CONTROLLER_TRANSPORT",),
+    )
+    for out in outs:
+        assert "DEGRADED_OK" in out, out
+        assert "falling back to Python coordination" in out, (
+            "expected the degraded-mode warning"
+        )
 
 
 @pytest.mark.slow
