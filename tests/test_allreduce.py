@@ -193,3 +193,41 @@ def test_allreduce_rejects_non_rank_major():
         hvd.allreduce(jnp.ones((3, 2)))
     with pytest.raises(ValueError, match="rank-major"):
         hvd.allreduce(jnp.float32(1.0))
+
+
+def test_eager_engine_thread_safety_stress():
+    """Many framework threads enqueueing named collectives concurrently —
+    the reference's engine is driven by framework executor threads; ours
+    must serialize flush/dispatch without deadlock or cross-talk
+    (single mutex-guarded queue, reference operations.cc:117-124)."""
+    import threading
+
+    n = hvd.size()
+    results: dict[str, np.ndarray] = {}
+    errors: list = []
+
+    def worker(tid: int):
+        try:
+            for j in range(12):
+                name = f"stress.{tid}.{j}"
+                x = hvd.per_rank(lambda r: jnp.full((8,), float(r + tid + j)))
+                out = hvd.allreduce(x, average=False, name=name)
+                results[name] = np.asarray(out)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((tid, e))
+
+    # daemon=True: a deadlocked worker must not keep the interpreter alive
+    # past the failed assert (the deadlock is what this test detects).
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "eager stress thread hung (deadlock)"
+    assert not errors, errors
+    assert len(results) == 8 * 12
+    for name, val in results.items():
+        tid, j = int(name.split(".")[1]), int(name.split(".")[2])
+        want = sum(r + tid + j for r in range(n))
+        np.testing.assert_allclose(val, want, err_msg=name)
