@@ -34,7 +34,10 @@ def main():
                    help="0 = model max_seq_len")
     p.add_argument("--lr", type=float, default=2e-5)
     p.add_argument("--attn", default="dense",
-                   choices=["dense", "blockwise", "ring", "ulysses", "flash"])
+                   choices=["dense", "blockwise", "ring", "ulysses",
+                            "ulysses_flash", "flash"])
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO sharded optimizer (state at 1/n per chip)")
     args = p.parse_args()
 
     hvd.init()
@@ -48,14 +51,20 @@ def main():
     params = hvd.broadcast_parameters(params, root_rank=0)
     loss_fn = llama.make_loss_fn(cfg)
 
-    tx = hvd.DistributedOptimizer(
-        optax.chain(
-            optax.clip_by_global_norm(1.0),
-            optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1),
+    adamw = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    if args.zero:
+        # Sharded optimizer: Adam moments at 1/n per chip; clipping uses
+        # the true global norm computed from the gradient shards.
+        step, init_opt = hvd.make_zero_train_step(
+            loss_fn, adamw, clip_global_norm=1.0
         )
-    )
-    opt_state = tx.init(params)
-    step = hvd.make_train_step(loss_fn, tx)
+        opt_state = init_opt(params)
+    else:
+        tx = hvd.DistributedOptimizer(
+            optax.chain(optax.clip_by_global_norm(1.0), adamw)
+        )
+        opt_state = tx.init(params)
+        step = hvd.make_train_step(loss_fn, tx)
 
     if hvd.rank() == 0:
         print(f"params: {llama.num_params(cfg) / 1e6:.1f}M  chips: {n}  "

@@ -16,9 +16,11 @@ program over the ``hvd`` axis:
 
 Works with **elementwise** optax transforms (adam/adamw/sgd/rmsprop/…):
 each parameter element's update depends only on its own gradient/state.
-Transforms that need global statistics across the whole pytree (e.g.
-``clip_by_global_norm``) would see per-shard statistics — compose those
-BEFORE the step's optimizer or avoid them.
+Transforms that need global statistics across the whole pytree would see
+per-shard statistics — for the common case, gradient clipping, pass
+``clip_global_norm=`` instead: the true global norm is one extra ``psum``
+of per-shard squared norms, computed on the *reduced* gradient exactly as
+``optax.clip_by_global_norm`` would see it in the replicated setup.
 
 Memory per chip: params P (replicated) + reduced grads P/n + opt state
 S/n, versus P + P + S for the replicated wrapper — for Adam (S = 2P) on
@@ -52,6 +54,8 @@ def make_zero_train_step(
     *,
     mesh: jax.sharding.Mesh | None = None,
     axis_name: str = AXIS_NAME,
+    clip_global_norm: float | None = None,
+    donate: bool = True,
 ) -> tuple[Callable[..., ZeroStepResult], Callable[[Any], Any]]:
     """Build a ZeRO train step; returns ``(step, init_opt_state)``.
 
@@ -115,6 +119,15 @@ def make_zero_train_step(
             gflat, _ = ravel_pytree(grads)
             gflat = (jnp.pad(gflat, (0, pad)) if pad else gflat) / n  # mean
             gshard = lax.psum_scatter(gflat, axis_name, tiled=True)   # [per]
+            if clip_global_norm is not None:
+                # True global norm from shard pieces: ||g||² = Σ_ranks ||g_r||²
+                # (shards are disjoint).  Matches optax.clip_by_global_norm
+                # on the replicated full gradient.
+                gsq = lax.psum(jnp.sum(gshard.astype(jnp.float32) ** 2),
+                               axis_name)
+                gnorm = jnp.sqrt(gsq)
+                scale = jnp.minimum(1.0, clip_global_norm / (gnorm + 1e-16))
+                gshard = gshard * scale.astype(gshard.dtype)
             pshard = my_slice(ravel_pytree(params)[0])
             updates, opt_state = optimizer.update(gshard, opt_state, pshard)
             pshard = optax.apply_updates(pshard, updates)
@@ -129,7 +142,11 @@ def make_zero_train_step(
                 in_specs=(P(), opt_specs, P(axis_name)),
                 out_specs=ZeroStepResult(P(), opt_specs, P()),
                 check_vma=False,
-            )
+            ),
+            # Donate params/opt_state (shapes+shardings match outputs) so
+            # the step doesn't hold duplicate replicated-param buffers —
+            # the memory headroom is the feature's point.
+            donate_argnums=(0, 1) if donate else (),
         )
         built.update(key=key, init=init_jitted, step=step_jitted)
         return built

@@ -61,6 +61,13 @@ def test_llama_finetune_tiny():
     )
 
 
+def test_llama_finetune_tiny_zero():
+    run_example(
+        "llama_finetune.py",
+        ["--tiny", "--steps", "2", "--seq-len", "64", "--zero"],
+    )
+
+
 @pytest.mark.slow
 def test_resnet50_smoke(tmp_path):
     run_example(

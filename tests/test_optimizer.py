@@ -220,7 +220,8 @@ def test_zero_step_matches_replicated_adam():
     n = hvd.size()
     params = {"w": jnp.arange(10.0) / 10, "b": jnp.ones((3,))}
 
-    zstep, zinit = hvd.make_zero_train_step(_loss_fn_quad, optax.adam(0.1))
+    zstep, zinit = hvd.make_zero_train_step(_loss_fn_quad, optax.adam(0.1),
+                                        donate=False)
     zstate = zinit(params)
     # array leaves shard: global leading dim = n * ceil(13/n)
     mu = jax.tree.leaves(zstate)[1]
@@ -246,3 +247,23 @@ def test_zero_step_matches_replicated_adam():
 def _loss_fn_quad(params, batch):
     scale = jnp.mean(batch)
     return scale * (jnp.sum(params["w"] ** 2) + jnp.sum(params["b"] ** 2))
+
+
+def test_zero_clip_global_norm_matches_replicated():
+    """ZeRO's clip_global_norm == optax.clip_by_global_norm on the full
+    gradient (shard norms sum to the true global norm)."""
+    params = {"w": jnp.arange(10.0), "b": jnp.full((3,), 5.0)}
+
+    zstep, zinit = hvd.make_zero_train_step(
+        _loss_fn_quad, optax.sgd(0.1), clip_global_norm=1.0, donate=False
+    )
+    rtx = hvd.DistributedOptimizer(
+        optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+    )
+    rstep = hvd.make_train_step(_loss_fn_quad, rtx, donate=False)
+
+    batch = hvd.per_rank(lambda r: jnp.full((2, 1), 2.0))
+    zout = zstep(params, zinit(params), batch)
+    rout = rstep(params, rtx.init(params), batch)
+    for a, b in zip(jax.tree.leaves(zout.params), jax.tree.leaves(rout.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
