@@ -68,6 +68,11 @@ def make_zero_train_step(
     arrays are ``[ceil(P/n)]`` regardless of the parameter pytree; scalar
     state leaves (step counts) stay replicated.  Programs are built once
     per parameter structure and cached.
+
+    ``donate`` (default True): the input ``params``/``opt_state`` buffers
+    are donated to the step — do not reuse them after calling; keep the
+    returned ones (pass ``donate=False`` to keep inputs alive, at the cost
+    of holding two parameter copies during the step).
     """
     if mesh is None:
         mesh = basics.mesh()

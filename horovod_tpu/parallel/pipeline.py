@@ -32,6 +32,7 @@ def pipeline_forward(
     x: jax.Array,
     *,
     axis_name: str = "pp",
+    remat: bool = False,
 ) -> jax.Array:
     """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis.
 
@@ -45,6 +46,9 @@ def pipeline_forward(
         :func:`pipeline_loss_fn` does.
       x: microbatched input ``[M, mb, ...]``, meaningful on stage 0 (other
         stages may pass the same array; it is ignored there).
+      remat: rematerialize the stage body in backward — AD then stores one
+        activation per tick instead of every intermediate inside
+        ``stage_fn`` (the deep-stage memory lever; costs ~1/3 extra FLOPs).
 
     Returns:
       ``[M, mb, ...]`` outputs, valid on the LAST stage (zeros elsewhere —
@@ -57,6 +61,11 @@ def pipeline_forward(
     ticks = m + s - 1
 
     fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+    # prevent_cse=False: the checkpointed body is differentiated under
+    # lax.scan, where the CSE-prevention barriers are unnecessary and block
+    # XLA fusion (the jax.checkpoint-documented scan-over-layers setting).
+    body = (jax.checkpoint(stage_fn, prevent_cse=False) if remat
+            else stage_fn)
 
     def tick(carry, t):
         recv, ys = carry
@@ -65,7 +74,7 @@ def pipeline_forward(
         mb = lax.dynamic_index_in_dim(x, jnp.minimum(t, m - 1), 0,
                                       keepdims=False)
         inp = jnp.where(stage == 0, mb.astype(recv.dtype), recv)
-        out = stage_fn(stage_params, inp)
+        out = body(stage_params, inp)
         # Last stage banks its result at microbatch slot t - (S - 1).
         slot = t - (s - 1)
         ys = lax.cond(
@@ -88,6 +97,7 @@ def pipeline_loss_fn(
     loss_fn: Callable[[jax.Array, Any], jax.Array],
     *,
     axis_name: str = "pp",
+    remat: bool = False,
 ) -> Callable[[Any, tuple[jax.Array, Any]], jax.Array]:
     """Package a per-stage body + final loss into a pipeline loss.
 
@@ -104,7 +114,7 @@ def pipeline_loss_fn(
         stage_params = jax.tree.map(lambda a: a[0], stage_params)
         x_micro, tgt_micro = batch
         ys = pipeline_forward(stage_fn, stage_params, x_micro,
-                              axis_name=axis_name)
+                              axis_name=axis_name, remat=remat)
         s = lax.axis_size(axis_name)
         is_last = (lax.axis_index(axis_name) == s - 1).astype(jnp.float32)
         losses = jax.vmap(loss_fn)(ys, tgt_micro)       # [M]
