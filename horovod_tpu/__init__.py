@@ -71,6 +71,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
 from horovod_tpu.optim.distributed_optimizer import (  # noqa: F401
     DistributedOptimizer,
     TrainStepResult,
+    allgather_object,
     allreduce_gradients,
     broadcast_object,
     broadcast_optimizer_state,

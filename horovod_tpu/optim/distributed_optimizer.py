@@ -209,6 +209,13 @@ def make_train_step(
 # ---------------------------------------------------------------------------
 
 
+def _root_process(root_rank: int) -> int:
+    """Process index owning device rank ``root_rank`` on the world mesh —
+    the single definition of the rank→process mapping used by every
+    any-root broadcast."""
+    return list(basics.mesh().devices.flat)[root_rank].process_index
+
+
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     """Make every process agree with the root's parameter pytree.
 
@@ -228,9 +235,8 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        root_process = list(basics.mesh().devices.flat)[root_rank].process_index
         return multihost_utils.broadcast_one_to_all(
-            params, is_source=jax.process_index() == root_process
+            params, is_source=jax.process_index() == _root_process(root_rank)
         )
     sharding = basics.replicated_sharding()
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), params)
@@ -268,7 +274,11 @@ def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
 
 def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     """Broadcast an arbitrary picklable object (the resume-epoch pattern of
-    reference examples/keras_imagenet_resnet50.py:66-73)."""
+    reference examples/keras_imagenet_resnet50.py:66-73).
+
+    ``root_rank`` is a device rank; the object travels from the process
+    that owns that device (any root works, like ``broadcast_parameters``).
+    """
     basics._require_init()
     if jax.process_count() == 1:
         return obj
@@ -276,14 +286,47 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
 
     from jax.experimental import multihost_utils
 
-    if basics.cross_rank() == 0:
+    is_source = basics.cross_rank() == _root_process(root_rank)
+    if is_source:
         payload = jnp.frombuffer(pickle.dumps(obj), dtype=jnp.uint8)
         length = jnp.asarray([payload.size], jnp.int32)
     else:
         payload = jnp.zeros((0,), jnp.uint8)
         length = jnp.asarray([0], jnp.int32)
-    n = int(multihost_utils.broadcast_one_to_all(length)[0])
-    if basics.cross_rank() != 0:
+    n = int(
+        multihost_utils.broadcast_one_to_all(length, is_source=is_source)[0]
+    )
+    if not is_source:
         payload = jnp.zeros((n,), jnp.uint8)
-    data = multihost_utils.broadcast_one_to_all(payload)
+    data = multihost_utils.broadcast_one_to_all(payload, is_source=is_source)
     return pickle.loads(bytes(bytearray(jax.device_get(data))))
+
+
+def allgather_object(obj: Any) -> list:
+    """Gather one picklable object per PROCESS; every process receives the
+    ``cross_size()``-long list ordered by process index.
+
+    The object-level sibling of the eager ``allgather`` (an API later
+    Horovod versions grew; natural here for gathering per-host metrics or
+    shapes).  Wire format: lengths all-gather, pad to max, bytes
+    all-gather, unpickle.
+    """
+    basics._require_init()
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj)
+    lengths = multihost_utils.process_allgather(
+        jnp.asarray([len(payload)], jnp.int32)
+    ).reshape(-1)                                       # [P]
+    pad = int(lengths.max())
+    buf = jnp.frombuffer(payload.ljust(pad, b"\0"), dtype=jnp.uint8)
+    data = multihost_utils.process_allgather(buf)       # [P, pad]
+    out = []
+    for p in range(int(lengths.shape[0])):
+        raw = bytes(bytearray(jax.device_get(data[p])))[: int(lengths[p])]
+        out.append(pickle.loads(raw))
+    return out

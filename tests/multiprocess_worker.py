@@ -57,10 +57,22 @@ def main() -> None:
     root_proc = list(hvd.mesh().devices.flat)[last].process_index
     assert np.allclose(np.asarray(out1["w"]), float(root_proc)), out1
 
-    # --- broadcast_object (resume-epoch pattern).
+    # --- broadcast_object (resume-epoch pattern), from rank 0 AND from a
+    # root owned by the other process (any-root parity).
     obj = {"epoch": 7, "note": "hello"} if hvd.cross_rank() == 0 else None
     got = hvd.broadcast_object(obj, root_rank=0)
     assert got == {"epoch": 7, "note": "hello"}, got
+    last_proc = list(hvd.mesh().devices.flat)[n - 1].process_index
+    obj2 = {"from": "tail"} if hvd.cross_rank() == last_proc else None
+    got2 = hvd.broadcast_object(obj2, root_rank=n - 1)
+    assert got2 == {"from": "tail"}, got2
+
+    # --- allgather_object: one (differently-sized) object per process.
+    mine = {"proc": me, "data": "x" * (10 + 20 * me)}
+    gathered = hvd.allgather_object(mine)
+    assert len(gathered) == hvd.cross_size(), gathered
+    for p, item in enumerate(gathered):
+        assert item == {"proc": p, "data": "x" * (10 + 20 * p)}, (p, item)
 
     # --- eager allreduce through the native TCP controller.
     from horovod_tpu.ops import eager as eager_mod

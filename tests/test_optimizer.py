@@ -267,3 +267,8 @@ def test_zero_clip_global_norm_matches_replicated():
     rout = rstep(params, rtx.init(params), batch)
     for a, b in zip(jax.tree.leaves(zout.params), jax.tree.leaves(rout.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_allgather_object_single_host():
+    out = hvd.allgather_object({"rank_data": 42})
+    assert out == [{"rank_data": 42}]
