@@ -262,22 +262,24 @@ def test_make_eval_step_averages_metrics():
     np.testing.assert_allclose(float(out["twice"]), 2 * expected, rtol=1e-6)
 
 
-def test_eager_optimizer_compressed_wire():
-    """EagerDistributedOptimizer with bf16 and int8 wire compression trains
+@pytest.mark.parametrize(
+    "comp", [hvd.Compression.bf16, hvd.Compression.int8]
+)
+def test_eager_optimizer_compressed_wire(comp):
+    """EagerDistributedOptimizer with bf16/int8 wire compression trains
     within compression tolerance of the uncompressed path."""
-    for comp in (hvd.Compression.bf16, hvd.Compression.int8):
-        loss_fn, params, x, y = _mlp_problem()
-        opt = hvd.EagerDistributedOptimizer(optax.sgd(0.1), compression=comp)
-        opt_state = opt.init(params)
-        batch = (jnp.asarray(x), jnp.asarray(y))
-        opt.backward(loss_fn, params, batch)
-        params2, _ = opt.step(params, opt_state)
+    loss_fn, params, x, y = _mlp_problem()
+    opt = hvd.EagerDistributedOptimizer(optax.sgd(0.1), compression=comp)
+    opt_state = opt.init(params)
+    batch = (jnp.asarray(x), jnp.asarray(y))
+    opt.backward(loss_fn, params, batch)
+    params2, _ = opt.step(params, opt_state)
 
-        ref = hvd.EagerDistributedOptimizer(optax.sgd(0.1))
-        ref_state = ref.init(params)
-        ref.backward(loss_fn, params, batch)
-        ref_params, _ = ref.step(params, ref_state)
-        np.testing.assert_allclose(
-            np.asarray(params2["w"]), np.asarray(ref_params["w"]),
-            atol=5e-2, err_msg=str(comp),
-        )
+    ref = hvd.EagerDistributedOptimizer(optax.sgd(0.1))
+    ref_state = ref.init(params)
+    ref.backward(loss_fn, params, batch)
+    ref_params, _ = ref.step(params, ref_state)
+    np.testing.assert_allclose(
+        np.asarray(params2["w"]), np.asarray(ref_params["w"]),
+        atol=5e-2, err_msg=str(comp),
+    )
