@@ -356,6 +356,11 @@ def main() -> None:
     }
     if result["mfu"] is not None:
         line["mfu"] = round(result["mfu"], 4)
+        if result["mfu"] > 1.0:
+            extras["mfu_note"] = (
+                "MFU>1 vs the nominal device-kind peak: the attached "
+                "backend exceeds one nominal chip (see docs/benchmarks.md)"
+            )
     line["extras"] = extras
     print(json.dumps(line))
 
