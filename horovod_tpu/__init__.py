@@ -55,6 +55,10 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     Sum,
 )
 from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.powersgd import (  # noqa: F401
+    ErrorFeedback,
+    PowerSGDCompressor,
+)
 from horovod_tpu.ops.eager import (  # noqa: F401
     allgather,
     allgather_async,

@@ -165,9 +165,14 @@ class Int8Compressor(Compressor):
     decompress = compress
 
     @classmethod
-    def quantized_allreduce(cls, tensor: jax.Array, *, average: bool = False,
-                            axis_name="hvd") -> jax.Array:
-        orig_dtype, orig_shape = tensor.dtype, tensor.shape
+    def _block_quantize(cls, tensor: jax.Array):
+        """The wire's quantizer — THE single definition of the int8 format.
+
+        Returns ``(q int8 [nb, B], scale f32 [nb, 1], n)`` where ``n`` is
+        the unpadded flat length.  Both the collective and the
+        error-feedback residual (ops/powersgd.py) go through here, so the
+        residual can never drift from what the wire actually carried.
+        """
         flat = tensor.astype(jnp.float32).reshape(-1)
         n = flat.shape[0]
         nblocks = -(-n // cls.BLOCK)
@@ -178,14 +183,27 @@ class Int8Compressor(Compressor):
         scale = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
         scale = jnp.maximum(scale, 1e-30)          # all-zero block guard
         q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, scale, n
+
+    @classmethod
+    def roundtrip(cls, tensor: jax.Array) -> jax.Array:
+        """quant→dequant of ``tensor`` through the exact wire format — what
+        this rank's contribution looks like after the collective."""
+        q, scale, n = cls._block_quantize(tensor)
+        out = (q.astype(jnp.float32) * scale).reshape(-1)[:n]
+        return out.reshape(tensor.shape)
+
+    @classmethod
+    def quantized_allreduce(cls, tensor: jax.Array, *, average: bool = False,
+                            axis_name="hvd") -> jax.Array:
+        orig_dtype, orig_shape = tensor.dtype, tensor.shape
+        q, scale, n = cls._block_quantize(tensor)
         all_q = lax.all_gather(q, axis_name)       # [size, nb, B] int8 wire
         all_s = lax.all_gather(scale, axis_name)   # [size, nb, 1] f32
         summed = jnp.sum(all_q.astype(jnp.float32) * all_s, axis=0)
         if average:
             summed = summed / all_q.shape[0]   # works for tuple axis_names too
-        out = summed.reshape(-1)
-        if pad:
-            out = out[:n]
+        out = summed.reshape(-1)[:n]
         return out.reshape(orig_shape).astype(orig_dtype)
 
 

@@ -1,0 +1,266 @@
+"""Stateful gradient compression: error feedback + PowerSGD.
+
+The fork's top-k scheme (reference horovod/torch/__init__.py:46-83,
+141-151) drops the (1−ratio) smallest gradient entries every step, which
+biases the descent direction.  The standard correction — kept by every
+production compressed-DP stack since — is **error feedback** (EF14/EF-SGD):
+remember the part of the gradient the wire dropped and add it back before
+compressing the next step.  **PowerSGD** (Vogels et al., 2019) is the
+strongest practical compressor in this family: a rank-``r`` approximation
+of each gradient matrix maintained by one warm-started power iteration, at
+the cost of two small all-reduces instead of one large one.
+
+Both are *stateful* (residuals; warm-started ``Q`` factors), which the
+reference's stateless ``Compressor`` interface cannot express.  The
+TPU-native home for that state is the optimizer state pytree: classes here
+implement the **stateful-compressor protocol**
+
+    init(grads_template)                       -> comp_state
+    reduce(grads, comp_state, *, axis_name, op) -> (reduced, comp_state)
+
+and :func:`horovod_tpu.DistributedOptimizer` threads the state through the
+compiled train step — everything stays inside the one SPMD program, so XLA
+overlaps the small PowerSGD all-reduces with backward just like the plain
+psum path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.basics import AXIS_NAME
+from horovod_tpu.ops.collective_ops import _axis_size
+from horovod_tpu.ops.compression import Int8Compressor, TopKCompressor
+
+
+class ErrorFeedback:
+    """Residual-corrected lossy all-reduce (EF-SGD / EF14).
+
+    Wraps a lossy compressor ``inner`` ∈ {:class:`TopKCompressor`,
+    :class:`Int8Compressor`} and keeps one residual per gradient leaf:
+
+        corrected = grad + residual
+        reduced   = lossy_allreduce(corrected)
+        residual' = corrected − transmitted(corrected)
+
+    where ``transmitted`` is what this rank actually contributed to the
+    wire (its own top-k entries / its own dequantized int8 blocks).  The
+    compression error therefore re-enters the next step instead of being
+    lost, which restores SGD's convergence rate under arbitrarily
+    aggressive compression.
+    """
+
+    def __init__(self, inner):
+        if not isinstance(inner, (TopKCompressor, Int8Compressor)) and not (
+            isinstance(inner, type)
+            and issubclass(inner, (TopKCompressor, Int8Compressor))
+        ):
+            raise TypeError(
+                "ErrorFeedback supports the lossy wire compressors "
+                f"(topk / int8); got {inner!r}. Dense cast compressors "
+                "(fp16/bf16) lose nothing an allreduce can recover — use "
+                "them directly."
+            )
+        if isinstance(inner, type):
+            inner = inner()
+        self.inner = inner
+
+    def init(self, grads_template) -> Any:
+        return jax.tree.map(
+            lambda g: jnp.zeros(g.shape, jnp.float32), grads_template
+        )
+
+    def _reduce_leaf(self, g, e, axis_name, average):
+        corrected = g.astype(jnp.float32) + e
+        if isinstance(self.inner, TopKCompressor):
+            flat = corrected.reshape(-1)
+            k = self.inner._k_for(flat.shape[0])
+            _, idxs = lax.top_k(jnp.abs(flat), k)
+            picked = flat[idxs]
+            all_vals = lax.all_gather(picked, axis_name, tiled=True)
+            all_idxs = lax.all_gather(idxs, axis_name, tiled=True)
+            dense = jnp.zeros_like(flat).at[all_idxs].add(all_vals)
+            if average:
+                dense = dense / _axis_size(axis_name)
+            transmitted = jnp.zeros_like(flat).at[idxs].set(picked)
+            residual = (flat - transmitted).reshape(corrected.shape)
+            return dense.reshape(corrected.shape).astype(g.dtype), residual
+        # int8: residual is this rank's own quantization error, computed by
+        # the wire's own quantizer so the two can never drift.
+        cls = type(self.inner)
+        reduced = cls.quantized_allreduce(
+            corrected, average=average, axis_name=axis_name
+        )
+        transmitted = cls.roundtrip(corrected)
+        return reduced.astype(g.dtype), corrected - transmitted
+
+    def reduce(self, grads, state, *, axis_name=AXIS_NAME, average=True):
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_e = treedef.flatten_up_to(state)
+        outs = [
+            self._reduce_leaf(g, e, axis_name, average)
+            for g, e in zip(flat_g, flat_e)
+        ]
+        reduced = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        new_state = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        return reduced, new_state
+
+
+class _PowerSGDLeafState(NamedTuple):
+    q: jax.Array          # [m, r] warm-started right factor
+    residual: jax.Array   # [n, m] error-feedback memory
+
+
+def _dense_sentinel() -> jax.Array:
+    """Marks a leaf that stays on the exact dense path.  An empty array —
+    not ``None`` — because the state rides inside the jitted optimizer
+    state, where every pytree leaf must be an array."""
+    return jnp.zeros((0,), jnp.float32)
+
+
+def _matrix_shape(shape: tuple) -> tuple[int, int]:
+    """Squarest 2-D view of a gradient: split the dims at the point that
+    best balances rows vs columns (conv kernels [h,w,ci,co] become
+    [h·w·ci, co]-ish, which is where their low-rank structure lives)."""
+    best, best_gap = (1, 1), None
+    prod = 1
+    for d in shape:
+        prod *= d
+    left = 1
+    for i in range(len(shape) + 1):
+        n, m = left, prod // left
+        gap = abs(n - m)
+        if best_gap is None or gap < best_gap:
+            best, best_gap = (n, m), gap
+        if i < len(shape):
+            left *= shape[i]
+    return best
+
+
+def _orthonormalize(p: jax.Array) -> jax.Array:
+    """Gram–Schmidt columns of ``p`` [n, r] — r is tiny, so the loop
+    unrolls to r VPU passes; avoids QR's host callbacks on TPU.
+
+    A column that is (numerically) dependent on the previous ones — the
+    gradient's true rank is below the compressor's budget — is ZEROED, not
+    normalized: dividing its ~0 norm would amplify cancellation noise into
+    a garbage direction and corrupt the projection P̂P̂ᵀ."""
+    cols = []
+    scale = jnp.maximum(jnp.max(jnp.linalg.norm(p, axis=0)), 1e-20)
+    for i in range(p.shape[1]):
+        c = p[:, i]
+        for prev in cols:
+            c = c - jnp.dot(prev, c) * prev
+        norm = jnp.linalg.norm(c)
+        c = jnp.where(
+            norm > 1e-6 * scale,
+            c / jnp.maximum(norm, 1e-20),
+            jnp.zeros_like(c),
+        )
+        cols.append(c)
+    return jnp.stack(cols, axis=1)
+
+
+class PowerSGDCompressor:
+    """Rank-``r`` PowerSGD all-reduce with warm start + error feedback.
+
+    Per 2-D-able gradient ``M`` [n, m] (others go dense):
+
+        M ← grad + residual
+        P = M·Q;  P ← mean over ranks;  P̂ = orthonormalize(P)
+        Q = Mᵀ·P̂; Q ← mean over ranks
+        M̂ = P̂·Qᵀ;  residual ← M − M̂
+
+    Wire cost per step is ``r·(n+m)`` floats instead of ``n·m`` — for a
+    4096×4096 layer at r=4 that is ~512× less traffic — and the warm-started
+    power iteration makes successive approximations track the dominant
+    gradient subspace.  ``min_compress_size`` keeps tiny leaves (biases,
+    norms) on the exact dense path, like the reference keeps small tensors
+    out of its sparse path.
+    """
+
+    def __init__(self, rank: int = 4, min_compress_size: int = 4096,
+                 seed: int = 0):
+        self.rank = rank
+        self.min_compress_size = min_compress_size
+        self.seed = seed
+
+    def _compresses(self, g) -> bool:
+        if g.size < self.min_compress_size:
+            return False
+        # A degenerate [1, N] view compresses to N+1 floats — MORE wire than
+        # the N-float psum it replaces.  Such leaves (1-D biases, fused
+        # vectors) stay on the exact dense path.
+        n, m = _matrix_shape(g.shape)
+        return min(n, m) > 1
+
+    def init(self, grads_template) -> Any:
+        leaves, treedef = jax.tree.flatten(grads_template)
+        states = []
+        for i, g in enumerate(leaves):
+            if not self._compresses(g):
+                states.append(_dense_sentinel())
+                continue
+            n, m = _matrix_shape(g.shape)
+            r = min(self.rank, n, m)
+            q = jax.random.normal(
+                jax.random.key(self.seed + i), (m, r), jnp.float32
+            )
+            states.append(_PowerSGDLeafState(
+                q=q, residual=jnp.zeros((n, m), jnp.float32)
+            ))
+        return jax.tree.unflatten(treedef, states)
+
+    def _reduce_leaf(self, g, st, axis_name, average):
+        if not isinstance(st, _PowerSGDLeafState):   # dense sentinel
+            out = lax.psum(g, axis_name)
+            if average:
+                out = out / _axis_size(axis_name)
+            return out, st
+        n, m = st.residual.shape
+        mat = g.astype(jnp.float32).reshape(n, m) + st.residual
+        p = mat @ st.q                                    # [n, r]
+        p = lax.pmean(p, axis_name)
+        p_hat = _orthonormalize(p)
+        q = mat.T @ p_hat                                 # [m, r]
+        q = lax.pmean(q, axis_name)
+        approx = p_hat @ q.T                              # ≈ mean over ranks
+        residual = mat - approx
+        out = approx if average else approx * _axis_size(axis_name)
+        return out.reshape(g.shape).astype(g.dtype), _PowerSGDLeafState(
+            q=q, residual=residual
+        )
+
+    def reduce(self, grads, state, *, axis_name=AXIS_NAME, average=True):
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_s = treedef.flatten_up_to(state)
+        outs = [
+            self._reduce_leaf(g, s, axis_name, average)
+            for g, s in zip(flat_g, flat_s)
+        ]
+        reduced = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        new_state = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        return reduced, new_state
+
+
+def is_stateful_compressor(obj: Any) -> bool:
+    """The protocol check DistributedOptimizer dispatches on.
+
+    Accepts instances AND classes — the registry convention elsewhere lets
+    users pass the bare class (``compression=Compression.int8``), so
+    ``compression=PowerSGDCompressor`` must not crash with an unbound-method
+    TypeError; DistributedOptimizer instantiates via
+    :func:`as_stateful_compressor`.
+    """
+    return callable(getattr(obj, "init", None)) and callable(
+        getattr(obj, "reduce", None)
+    )
+
+
+def as_stateful_compressor(obj: Any) -> Any:
+    """Normalize a stateful compressor: instantiate if given the class."""
+    return obj() if isinstance(obj, type) else obj

@@ -72,6 +72,15 @@ def allreduce_gradients(
     return jax.tree.unflatten(treedef, reduced)
 
 
+class _StatefulCompressionState(NamedTuple):
+    """Optimizer-state wrapper when a stateful compressor is attached:
+    ``comp`` holds residuals / warm-started factors, ``inner`` the wrapped
+    optax state."""
+
+    comp: Any
+    inner: Any
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
@@ -104,13 +113,47 @@ def DistributedOptimizer(
 
     Must run inside SPMD code where ``axis_name`` is bound (shard_map/pjit
     over the hvd mesh) — the analogue of "must run under mpirun".
+
+    ``compression`` may also be a *stateful* compressor implementing the
+    ``init(grads_template)`` / ``reduce(grads, state, ...)`` protocol —
+    :class:`horovod_tpu.ops.powersgd.PowerSGDCompressor` or
+    :class:`~horovod_tpu.ops.powersgd.ErrorFeedback` around topk/int8.  Its
+    state (residuals, warm-started factors) rides in the optimizer state.
     """
+    from horovod_tpu.ops.powersgd import (
+        as_stateful_compressor,
+        is_stateful_compressor,
+    )
+
+    # local=True never touches the wire, so residuals/factors would be dead
+    # gradient-sized state — skip the stateful machinery entirely.
+    stateful = is_stateful_compressor(compression) and not local
+    if stateful:
+        compression = as_stateful_compressor(compression)
+        if is_sparse:
+            raise ValueError(
+                "is_sparse picks the top-k collective; a stateful compressor "
+                "already defines its own wire — wrap TopKCompressor in "
+                "ErrorFeedback instead of combining the two flags."
+            )
 
     def init_fn(params):
-        return optimizer.init(params)
+        inner = optimizer.init(params)
+        if stateful:
+            return _StatefulCompressionState(
+                comp=compression.init(params), inner=inner
+            )
+        return inner
 
     def update_fn(grads, state, params=None, **extra):
-        if not local:
+        comp, inner = (state.comp, state.inner) if stateful else (None, state)
+        if local:
+            reduced = grads
+        elif stateful:
+            reduced, comp = compression.reduce(
+                grads, comp, axis_name=axis_name, average=op is Average
+            )
+        else:
             reduced = allreduce_gradients(
                 grads,
                 op=op,
@@ -120,9 +163,10 @@ def DistributedOptimizer(
                 sparse=is_sparse,
                 sparse_ratio=sparse_ratio,
             )
-        else:
-            reduced = grads
-        return optimizer.update(reduced, state, params, **extra)
+        updates, inner = optimizer.update(reduced, inner, params, **extra)
+        if stateful:
+            return updates, _StatefulCompressionState(comp=comp, inner=inner)
+        return updates, inner
 
     tx = optax.GradientTransformation(init_fn, update_fn)
     if backward_passes_per_step > 1:
