@@ -1,0 +1,189 @@
+"""Autotuner — online search over fusion threshold and cycle time.
+
+The reference generation of Horovod (0.15.1) exposes
+``HOROVOD_FUSION_THRESHOLD`` / ``HOROVOD_CYCLE_TIME`` as static knobs the
+user must hand-tune per model (reference horovod/common/operations.cc:
+1614-1685); the project's later releases grew ``HOROVOD_AUTOTUNE``, a
+background search that adjusts both while training runs.  This is the
+TPU-native equivalent for the eager engine: a **coordinate-descent hill
+climber** over a log-spaced threshold grid and a cycle-time grid, scored by
+observed wire throughput.
+
+Why hill-climbing and not Bayesian optimization: the search space here is a
+tiny 2-D grid (the compiled SPMD path doesn't need tuning at all — XLA owns
+fusion there), samples are cheap (every flush is one), and a monotone
+hill climber is deterministic and explainable in the autotune log.
+
+Mechanics: the engine calls :meth:`Autotuner.observe` after each flush that
+dispatched at least one fused allreduce, passing the per-rank bytes moved
+and one output array of the batch.  Samples accumulate into a window; when
+the window closes (enough flushes AND enough bytes), the autotuner blocks
+on that output array — making the window's wall-clock cover actual device
+completion, not just async dispatch — scores the current setting in
+bytes/sec, writes a log row, and either moves to a neighboring setting or,
+once no neighbor beats the incumbent, pins the best setting and stops.
+
+Enable with ``HOROVOD_AUTOTUNE=1``; ``HOROVOD_AUTOTUNE_LOG=<file>`` writes
+a CSV of (setting, score) rows — both knob names shared with later
+Horovod so launch scripts carry over.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any
+
+MiB = 1024 * 1024
+
+# Log-spaced search grids.  0 disables fusion entirely (every tensor its
+# own collective) — a real candidate: for large-tensor workloads fusion
+# only adds concat latency.
+THRESHOLD_GRID = (0, 1 * MiB, 4 * MiB, 16 * MiB, 64 * MiB, 256 * MiB)
+CYCLE_GRID_MS = (1.0, 2.5, 5.0, 10.0, 25.0)
+
+
+class Autotuner:
+    """Coordinate-descent over (fusion_threshold, cycle_time).
+
+    Owns no threads: driven entirely by ``observe()`` calls from the
+    engine's cycle thread, and mutates ``config`` in place (the engine
+    reads both knobs from config on every flush/tick).
+    """
+
+    def __init__(self, config, *, warmup_samples: int = 3,
+                 window_flushes: int = 10, min_window_bytes: int = 1 * MiB,
+                 log_path: str | None = None):
+        import threading
+
+        self.config = config
+        self.warmup_samples = warmup_samples
+        self.warmup_left = warmup_samples
+        self.window_flushes = window_flushes
+        self.min_window_bytes = min_window_bytes
+        self.log_path = log_path
+        self.done = False
+        # observe() is called outside the engine's flush lock (so the
+        # device-completion probe can't stall concurrent flushes); guard
+        # the tuner's own state against concurrent flush threads instead.
+        self._obs_lock = threading.Lock()
+
+        ti = _nearest(THRESHOLD_GRID, config.fusion_threshold_bytes)
+        ci = _nearest(CYCLE_GRID_MS, config.cycle_time_ms)
+        self._pos = (ti, ci)
+        self._scores: dict[tuple[int, int], float] = {}
+        self._pending: list[tuple[int, int]] = []
+        self._coord = 0            # 0: tune threshold, 1: tune cycle time
+        self._stale_coords = 0     # coords in a row with no improvement
+        self._win_bytes = 0
+        self._win_flushes = 0
+        self._win_t0: float | None = None
+        self._win_last_out: Any = None
+        if self.log_path:
+            with open(self.log_path, "w") as f:
+                f.write("threshold_bytes,cycle_time_ms,score_bytes_per_sec,best\n")
+
+    # ------------------------------------------------------------------ engine
+
+    def observe(self, nbytes: int, last_out: Any) -> None:
+        """One flush's worth of dispatched allreduce traffic."""
+        if self.done or nbytes <= 0:
+            return
+        with self._obs_lock:
+            if self.warmup_left > 0:   # discard compile-dominated flushes
+                self.warmup_left -= 1
+                return
+            if self._win_t0 is None:
+                self._win_t0 = time.monotonic()
+            self._win_bytes += nbytes
+            self._win_flushes += 1
+            self._win_last_out = last_out
+            if (self._win_flushes >= self.window_flushes
+                    and self._win_bytes >= self.min_window_bytes):
+                self._close_window()
+
+    # ------------------------------------------------------------------ search
+
+    def _close_window(self) -> None:
+        import jax
+
+        if self._win_last_out is not None:
+            try:
+                jax.block_until_ready(self._win_last_out)
+            except Exception:
+                pass
+        elapsed = max(time.monotonic() - self._win_t0, 1e-9)
+        score = self._win_bytes / elapsed
+        self._scores[self._pos] = max(self._scores.get(self._pos, 0.0), score)
+        self._log_row(score)
+        self._win_bytes = 0
+        self._win_flushes = 0
+        self._win_t0 = None
+        self._win_last_out = None
+        self._advance()
+
+    def _advance(self) -> None:
+        if not self._pending:
+            # Current coordinate swept?  Candidates = unvisited neighbors of
+            # the best point along the active coordinate.
+            best = max(self._scores, key=self._scores.__getitem__)
+            grid = THRESHOLD_GRID if self._coord == 0 else CYCLE_GRID_MS
+            i = best[self._coord]
+            neighbors = [
+                _with_coord(best, self._coord, j)
+                for j in (i - 1, i + 1)
+                if 0 <= j < len(grid)
+            ]
+            self._pending = [p for p in neighbors if p not in self._scores]
+            if not self._pending:
+                # No unexplored neighbor on this coordinate: switch, and if
+                # BOTH coordinates are locally optimal, finish.
+                self._stale_coords += 1
+                self._coord ^= 1
+                if self._stale_coords >= 2:
+                    self._finish(best)
+                    return
+                self._advance()
+                return
+            self._stale_coords = 0
+        self._move_to(self._pending.pop(0))
+
+    def _move_to(self, pos: tuple[int, int]) -> None:
+        self._pos = pos
+        self.config.fusion_threshold_bytes = THRESHOLD_GRID[pos[0]]
+        self.config.cycle_time_ms = CYCLE_GRID_MS[pos[1]]
+        # A new threshold changes bucket shapes → the next flushes pay XLA
+        # compilation.  Each grid point is scored exactly once, so letting
+        # compile time into its one window would permanently penalize every
+        # newly-visited setting; re-warm after every move.
+        self.warmup_left = self.warmup_samples
+
+    def _finish(self, best: tuple[int, int]) -> None:
+        self._move_to(best)
+        self.done = True
+        self._log_row(self._scores[best], best=True)
+        print(
+            "horovod_tpu autotune converged: "
+            f"HOROVOD_FUSION_THRESHOLD={THRESHOLD_GRID[best[0]]} "
+            f"HOROVOD_CYCLE_TIME={CYCLE_GRID_MS[best[1]]} "
+            f"({self._scores[best] / MiB:.1f} MiB/s observed)",
+            file=sys.stderr,
+        )
+
+    def _log_row(self, score: float, best: bool = False) -> None:
+        if not self.log_path:
+            return
+        with open(self.log_path, "a") as f:
+            f.write(
+                f"{self.config.fusion_threshold_bytes},"
+                f"{self.config.cycle_time_ms},{score:.1f},"
+                f"{int(best)}\n"
+            )
+
+
+def _nearest(grid, value) -> int:
+    return min(range(len(grid)), key=lambda i: abs(grid[i] - value))
+
+
+def _with_coord(pos: tuple[int, int], coord: int, j: int) -> tuple[int, int]:
+    return (j, pos[1]) if coord == 0 else (pos[0], j)

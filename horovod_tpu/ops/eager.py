@@ -125,6 +125,34 @@ class EagerEngine:
             # (reference timeline.cc:98-132); drained after every tick.
             self.controller.enable_tick_trace()
         self._submitted: dict[str, _PendingOp] = {}
+        self.autotuner = None
+        if cfg.autotune:
+            if self.controller is not None or jax.process_count() > 1:
+                # Two reasons to refuse: (a) the native controller's fusion
+                # threshold is fixed at construction and rank 0 owns fusion
+                # decisions for every rank — local mutation would be a lie;
+                # (b) in a multi-controller job WITHOUT the controller,
+                # per-host tuners scored on host-local noise would move to
+                # different thresholds at different times, split the same
+                # group into different buckets per host, and deadlock the
+                # differently-fused collectives (see _fuse_key).
+                print(
+                    "WARNING: HOROVOD_AUTOTUNE=1 ignored: autotuning "
+                    "applies to single-process Python-coordinated engines "
+                    "only (native-controller fusion is fixed at startup; "
+                    "independent per-host tuning would diverge bucket "
+                    "plans across hosts).",
+                    file=sys.stderr,
+                )
+            else:
+                from horovod_tpu.autotune import Autotuner
+
+                self.autotuner = Autotuner(
+                    cfg,
+                    warmup_samples=cfg.autotune_warmup_samples,
+                    window_flushes=cfg.autotune_steady_state_samples,
+                    log_path=cfg.autotune_log,
+                )
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )
@@ -245,6 +273,7 @@ class EagerEngine:
         """
         from horovod_tpu.ops import fusion
 
+        tune_sample = None
         with self._flush_lock:
             with self._lock:
                 batch, self._queue = self._queue, []
@@ -264,13 +293,26 @@ class EagerEngine:
                 nbytes=lambda p: _per_rank_nbytes(p.tensor),
                 key=self._fuse_key,
             )
+            ar_bytes, sample_out = 0, None
             for bucket in buckets:
                 group = [batch[i] for i in bucket]
                 if group[0].kind == "allreduce":
-                    self._dispatch_allreduce_group(group)
+                    out = self._dispatch_allreduce_group(group)
+                    if out is not None:
+                        ar_bytes += sum(
+                            _per_rank_nbytes(p.tensor) for p in group
+                        )
+                        sample_out = out
                 else:
                     assert len(group) == 1
                     self._dispatch_single(group[0])
+            if self.autotuner is not None and ar_bytes:
+                tune_sample = (ar_bytes, sample_out)
+        # Score OUTSIDE the flush lock: closing a window blocks on device
+        # completion of the probe, and holding the lock through that would
+        # stall every concurrent synchronize()/poll() flush.
+        if tune_sample is not None:
+            self.autotuner.observe(*tune_sample)
 
     _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "sparse": 3}
 
@@ -376,9 +418,10 @@ class EagerEngine:
 
     def _cycle_loop(self) -> None:
         """Background tick every ``HOROVOD_CYCLE_TIME`` ms
-        (reference operations.cc:1795 tick + :1661-1685 knob)."""
-        period = max(self.config.cycle_time_ms, 0.1) / 1000.0
+        (reference operations.cc:1795 tick + :1661-1685 knob).  The period
+        is re-read every iteration: the autotuner mutates it mid-run."""
         while not self._shutdown.is_set():
+            period = max(self.config.cycle_time_ms, 0.1) / 1000.0
             self._tick.wait(timeout=period)
             self._tick.clear()
             try:
@@ -480,7 +523,9 @@ class EagerEngine:
             self._dispatch_cache[key] = fn
         return fn
 
-    def _dispatch_allreduce_group(self, group: list[_PendingOp]) -> None:
+    def _dispatch_allreduce_group(self, group: list[_PendingOp]):
+        """Dispatch one fused bucket; returns the last output array (for
+        the autotuner's completion probe) or None on error."""
         names = [p.name for p in group]
         if self.timeline:
             for n in names:
@@ -493,9 +538,11 @@ class EagerEngine:
                 self.handles.mark_dispatched(
                     p.handle, out.reshape(p.tensor.shape[1:])
                 )
+            return outs[-1]
         except Exception as e:
             for p in group:
                 self.handles.mark_error(p.handle, e)
+            return None
         finally:
             if self.timeline:
                 for n in names:

@@ -19,6 +19,12 @@ HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_SPARSE_ALLREDUCE = "HOROVOD_SPARSE_ALLREDUCE"
+# Autotune knob names shared with later Horovod releases, which grew an
+# online tuner for the same two knobs (threshold/cycle); see autotune.py.
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES = "HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES"
 
 # Defaults mirror reference horovod/common/operations.cc:151 (64 MiB fusion
 # buffer), :155 (5 ms cycle) and :273 (60 s stall warning).
@@ -81,6 +87,13 @@ class EngineConfig:
     # Transport spec for the native control plane: "tcp:<host>:<port>"
     # (multi-host; rank 0 binds) or "local:<world>" (in-process).
     controller_transport: str | None = None
+    # Online (threshold, cycle-time) tuning — horovod_tpu/autotune.py.
+    # These two knobs are the only MUTABLE config fields: the autotuner
+    # rewrites them mid-run and the engine re-reads both every tick.
+    autotune: bool = False
+    autotune_log: str | None = None
+    autotune_warmup_samples: int = 3
+    autotune_steady_state_samples: int = 10
 
     @classmethod
     def from_env(cls) -> "EngineConfig":
@@ -105,4 +118,10 @@ class EngineConfig:
             controller_transport=os.environ.get(
                 "HOROVOD_TPU_CONTROLLER_TRANSPORT"
             ) or None,
+            autotune=_get_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG) or None,
+            autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steady_state_samples=_get_int(
+                HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES, 10
+            ),
         )

@@ -1,0 +1,151 @@
+"""Autotuner: online (fusion threshold, cycle time) search.
+
+Later Horovod's HOROVOD_AUTOTUNE capability, TPU-native (autotune.py).
+Unit-level: the hill climber converges to the best grid point of a known
+synthetic score surface, mutates config in place, stops when locally
+optimal, and logs rows.  Integration: a real engine under
+HOROVOD_AUTOTUNE=1 tunes while eager traffic flows and the chosen setting
+is one of the grid points.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.autotune import CYCLE_GRID_MS, THRESHOLD_GRID, Autotuner
+from horovod_tpu.utils.env import EngineConfig
+
+
+class _Clock:
+    """Deterministic monotonic clock: each window takes a time set by the
+    synthetic surface, so scores are exact."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(tuner, clock, surface, max_windows=200):
+    """Feed windows until convergence; ``surface(threshold, cycle) ->
+    bytes/sec`` defines the synthetic truth."""
+    for _ in range(max_windows):
+        if tuner.done:
+            return
+        # One full window of flushes at the current setting.
+        rate = surface(
+            tuner.config.fusion_threshold_bytes, tuner.config.cycle_time_ms
+        )
+        per_flush = max(tuner.min_window_bytes // tuner.window_flushes + 1,
+                        1)
+        for _ in range(tuner.window_flushes):
+            tuner.observe(per_flush, None)
+            if tuner._win_t0 is not None:
+                clock.t += per_flush / rate
+    raise AssertionError("autotuner did not converge")
+
+
+@pytest.fixture()
+def patched_clock(monkeypatch):
+    clock = _Clock()
+    import horovod_tpu.autotune as at
+
+    monkeypatch.setattr(at.time, "monotonic", clock)
+    return clock
+
+
+def test_autotuner_climbs_to_best_threshold(patched_clock):
+    cfg = EngineConfig(fusion_threshold_bytes=0, cycle_time_ms=5.0)
+    tuner = Autotuner(cfg, warmup_samples=0, window_flushes=4,
+                      min_window_bytes=1024)
+    best_t = THRESHOLD_GRID[3]        # 16 MiB is the synthetic optimum
+
+    def surface(thr, cyc):
+        return 1e6 / (1 + abs(thr - best_t) / (1024 * 1024)) / (1 + abs(cyc - 5.0))
+
+    _drive(tuner, patched_clock, surface)
+    assert cfg.fusion_threshold_bytes == best_t
+    assert cfg.cycle_time_ms == 5.0
+    assert tuner.done
+
+
+def test_autotuner_tunes_cycle_time_too(patched_clock):
+    cfg = EngineConfig(fusion_threshold_bytes=64 * 1024 * 1024,
+                       cycle_time_ms=5.0)
+    tuner = Autotuner(cfg, warmup_samples=0, window_flushes=4,
+                      min_window_bytes=1024)
+
+    def surface(thr, cyc):
+        # Optimum at (64 MiB, 1 ms): faster cycles always better here.
+        return 1e6 / (1 + abs(thr - 64 * 1024 * 1024)) / cyc
+
+    _drive(tuner, patched_clock, surface)
+    assert cfg.cycle_time_ms == CYCLE_GRID_MS[0]
+    assert tuner.done
+
+
+def test_autotuner_warmup_discards_samples(patched_clock):
+    cfg = EngineConfig()
+    tuner = Autotuner(cfg, warmup_samples=5, window_flushes=2,
+                      min_window_bytes=1)
+    for _ in range(5):
+        tuner.observe(1 << 20, None)
+    assert tuner._win_flushes == 0      # all discarded
+    assert not tuner._scores
+
+
+def test_autotuner_writes_log(tmp_path, patched_clock):
+    log = tmp_path / "autotune.csv"
+    cfg = EngineConfig()
+    tuner = Autotuner(cfg, warmup_samples=0, window_flushes=2,
+                      min_window_bytes=1024, log_path=str(log))
+
+    def surface(thr, cyc):
+        return 1e6
+
+    _drive(tuner, patched_clock, surface)
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == "threshold_bytes,cycle_time_ms,score_bytes_per_sec,best"
+    assert len(lines) > 2
+    assert lines[-1].endswith(",1")     # final row marks the winner
+
+
+def test_engine_autotunes_under_eager_traffic():
+    """HOROVOD_AUTOTUNE=1 end-to-end: traffic flows, settings only ever
+    come from the grids, results stay correct, and the tuner makes
+    progress (scores recorded)."""
+    hvd.shutdown()
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES"] = "2"
+    try:
+        hvd.init()
+        eng = hvd.ops.eager._engine()
+        assert eng.autotuner is not None
+        n = hvd.size()
+        grads = [
+            hvd.per_rank(lambda r: jnp.full((4096,), float(r + i)))
+            for i in range(4)
+        ]
+        expected = [
+            np.full((4096,), (n - 1) / 2.0 + i, np.float32) for i in range(4)
+        ]
+        for _ in range(30):
+            outs = hvd.grouped_allreduce_eager(grads, average=True)
+            for o, e in zip(outs, expected):
+                np.testing.assert_allclose(np.asarray(o), e, rtol=1e-6)
+            if eng.autotuner.done:
+                break
+        assert eng.autotuner._scores, "no window ever closed"
+        assert eng.config.fusion_threshold_bytes in THRESHOLD_GRID
+        assert eng.config.cycle_time_ms in CYCLE_GRID_MS
+    finally:
+        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                  "HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES"):
+            os.environ.pop(k, None)
+        hvd.shutdown()
+        hvd.init()
