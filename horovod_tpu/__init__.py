@@ -48,6 +48,7 @@ from horovod_tpu.basics import (  # noqa: F401
     size,
 )
 from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Adasum,
     Average,
     Max,
     Min,

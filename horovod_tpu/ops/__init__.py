@@ -1,11 +1,13 @@
 """Collective ops: in-graph (SPMD) and eager (rank-major) flavors."""
 
 from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Adasum,
     Average,
     Max,
     Min,
     Product,
     Sum,
+    adasum_allreduce,
     allgather,
     allreduce,
     alltoall,

@@ -41,12 +41,15 @@ class _ReduceOp:
 
 # Reduction op vocabulary.  The reference only ships SUM (with client-side
 # divide for average — horovod/tensorflow/__init__.py:45-87); Min/Max/Product
-# are included because lax provides them for free on TPU.
+# are included because lax provides them for free on TPU; Adasum is the
+# scaled-sensitivity combination the Horovod project added in 0.20 (here a
+# ppermute butterfly — see adasum_allreduce).
 Sum = _ReduceOp("Sum")
 Average = _ReduceOp("Average")
 Min = _ReduceOp("Min")
 Max = _ReduceOp("Max")
 Product = _ReduceOp("Product")
+Adasum = _ReduceOp("Adasum")
 
 
 def _axis_size(axis_name) -> jax.Array | int:
@@ -74,6 +77,80 @@ def _reduce(x: jax.Array, op: _ReduceOp, axis_name) -> jax.Array:
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+def _adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The Adasum combination of two flat fp32 gradients:
+
+        adasum(a, b) = (1 − a·b / 2‖a‖²)·a + (1 − a·b / 2‖b‖²)·b
+
+    When a ⊥ b this is a+b (independent descent directions add); when
+    a ∥ b it is their average (redundant directions don't double-step).
+    A zero operand degrades to returning the other (the max() guard makes
+    its coefficient 1 and its own term 0)."""
+    dot = jnp.vdot(a, b)
+    na2 = jnp.vdot(a, a)
+    nb2 = jnp.vdot(b, b)
+    tiny = jnp.asarray(1e-30, a.dtype)
+    ca = 1.0 - dot / jnp.maximum(2.0 * na2, tiny)
+    cb = 1.0 - dot / jnp.maximum(2.0 * nb2, tiny)
+    return ca * a + cb * b
+
+
+def adasum_allreduce(
+    tensor: jax.Array,
+    *,
+    axis_name=AXIS_NAME,
+) -> jax.Array:
+    """Adasum reduction over the mesh axis (Horovod ≥0.20 capability).
+
+    Power-of-two worlds run the recursive-doubling **butterfly**: log₂(n)
+    ``ppermute`` exchange rounds, each rank combining with its partner at
+    distance 2ⁱ — the combination is symmetric, so partners stay identical
+    and the result is replicated with n·log₂(n) total wire instead of a
+    gather.  Other world sizes (and tuple axes) all-gather and reduce the
+    same fixed pairwise tree locally (deterministic and rank-identical by
+    construction).  Dot products and norms are taken over THIS tensor
+    only, which is why Adasum ops never join fusion buckets — a fused
+    buffer would mix unrelated layers into one inner product.
+
+    Wire dtype: the tensor's own floating dtype (a 16-bit tensor from a
+    cast compressor moves 16-bit words on every exchange); arithmetic is
+    fp32.  Rank-symmetry is preserved by combining the quantized copy of
+    SELF with the quantized copy of the partner — both sides then compute
+    on identical operands, so the result stays replicated.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return tensor
+    orig_dtype = tensor.dtype
+    wire_dtype = (
+        orig_dtype if jnp.issubdtype(orig_dtype, jnp.floating)
+        else jnp.float32
+    )
+    v = tensor.reshape(-1).astype(jnp.float32)
+    if n & (n - 1) == 0 and not isinstance(axis_name, (tuple, list)):
+        for i in range(n.bit_length() - 1):
+            d = 1 << i
+            perm = [(r, r ^ d) for r in range(n)]
+            send = v.astype(wire_dtype)
+            pv = lax.ppermute(send, axis_name, perm)
+            v = _adasum_pair(
+                send.astype(jnp.float32), pv.astype(jnp.float32)
+            )
+    else:
+        vs = lax.all_gather(v.astype(wire_dtype), axis_name)   # [n, d]
+        level = [vs[i].astype(jnp.float32) for i in range(n)]
+        while len(level) > 1:
+            nxt = [
+                _adasum_pair(level[2 * j], level[2 * j + 1])
+                for j in range(len(level) // 2)
+            ]
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+        v = level[0]
+    return v.reshape(tensor.shape).astype(orig_dtype)
+
+
 def allreduce(
     tensor: jax.Array,
     average: bool | None = None,
@@ -99,6 +176,17 @@ def allreduce(
         op = Average if average else Sum
     if op in (Min, Max, Product):
         return _reduce(tensor, op, axis_name)
+    if op is Adasum:
+        if callable(getattr(compression, "quantized_allreduce", None)):
+            raise ValueError(
+                "Adasum does not support wire-format compressors (int8): "
+                "the combination needs full vectors on every exchange. "
+                "Use Compression.fp16/bf16 — Adasum then moves 16-bit "
+                "words on the wire."
+            )
+        compressed, ctx = compression.compress(tensor)
+        reduced = adasum_allreduce(compressed, axis_name=axis_name)
+        return compression.decompress(reduced, ctx)
     quantized = getattr(compression, "quantized_allreduce", None)
     if callable(quantized):
         # Wire-format compressors (int8) replace the collective itself:
@@ -130,6 +218,10 @@ def grouped_allreduce(
 
     if average is not None:
         op = Average if average else Sum
+    if op is Adasum:
+        # Adasum's dot products are per-tensor; a fused buffer would mix
+        # unrelated layers into one inner product.  One collective each.
+        fusion_threshold_bytes = 0
     return fusion.fused_apply(
         list(tensors),
         lambda flat: allreduce(

@@ -253,6 +253,9 @@ class EagerEngine:
         """
         if p.kind != "allreduce":
             return ("solo", p.handle)
+        if p.op is collective_ops.Adasum:
+            # Per-tensor inner products: never share a fused buffer.
+            return ("solo", p.handle)
         base = ("ar", p.op.name, p.compression, str(p.tensor.dtype))
         if jax.process_count() > 1:
             return base + (

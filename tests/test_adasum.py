@@ -1,0 +1,231 @@
+"""Adasum reduction (Horovod ≥0.20 capability, TPU-native butterfly).
+
+Semantic anchors: orthogonal gradients ADD (independent directions),
+parallel gradients AVERAGE (redundant directions), and the in-graph
+butterfly matches a NumPy model of the identical combination tree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import ops
+
+
+def _smap(fn, out_specs=P()):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=hvd.mesh(), in_specs=P(hvd.AXIS_NAME),
+            out_specs=out_specs, check_vma=False,
+        )
+    )
+
+
+def _adasum_pair_np(a, b):
+    dot = float(np.dot(a, b))
+    na2 = float(np.dot(a, a))
+    nb2 = float(np.dot(b, b))
+    ca = 1.0 - dot / max(2 * na2, 1e-30)
+    cb = 1.0 - dot / max(2 * nb2, 1e-30)
+    return ca * a + cb * b
+
+
+def _adasum_tree_np(vs):
+    """The same butterfly/pairwise tree the in-graph op computes."""
+    level = list(vs)
+    while len(level) > 1:
+        nxt = [
+            _adasum_pair_np(level[2 * j], level[2 * j + 1])
+            for j in range(len(level) // 2)
+        ]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def test_adasum_orthogonal_adds():
+    """8 mutually-orthogonal per-rank gradients: adasum == plain sum."""
+    n = hvd.size()
+    per_rank = np.zeros((n, n), np.float32)
+    for r in range(n):
+        per_rank[r, r] = r + 1.0                      # e_r scaled
+    f = _smap(lambda a: ops.allreduce(a[0], op=ops.Adasum))
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    np.testing.assert_allclose(out, per_rank.sum(0), rtol=1e-5)
+
+
+def test_adasum_parallel_averages():
+    """Identical per-rank gradients: adasum == the average (one step, not
+    n redundant steps)."""
+    n = hvd.size()
+    g = np.linspace(1.0, 2.0, 16, dtype=np.float32)
+    per_rank = np.tile(g, (n, 1))
+    f = _smap(lambda a: ops.allreduce(a[0], op=ops.Adasum))
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    np.testing.assert_allclose(out, g, rtol=1e-5)
+
+
+def test_adasum_butterfly_matches_numpy_tree():
+    rng = np.random.RandomState(3)
+    n = hvd.size()
+    per_rank = rng.randn(n, 33).astype(np.float32)    # odd length on purpose
+    f = _smap(lambda a: ops.allreduce(a[0], op=ops.Adasum))
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    expected = _adasum_tree_np([per_rank[r] for r in range(n)])
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=1e-5)
+
+
+def test_adasum_result_replicated_and_shape_preserved():
+    rng = np.random.RandomState(4)
+    n = hvd.size()
+    per_rank = rng.randn(n, 3, 5).astype(np.float32)
+    out_all = _smap(
+        lambda a: ops.allreduce(a[0], op=ops.Adasum),
+        out_specs=P(hvd.AXIS_NAME),
+    )
+    # out_specs P over a replicated value stacks each rank's copy: all equal.
+    stacked = np.asarray(
+        out_all(jnp.asarray(per_rank.reshape(n, -1)))
+    ).reshape(n, -1)
+    for r in range(1, n):
+        # Per-rank copies agree to reduction-order float noise (the
+        # butterfly's math is rank-symmetric; XLA's fused partial-sum
+        # order is not bit-identical across shards).
+        np.testing.assert_allclose(stacked[r], stacked[0], rtol=1e-5,
+                                   atol=1e-5)
+
+    f = _smap(lambda a: ops.allreduce(a[0], op=ops.Adasum))
+    assert f(jnp.asarray(per_rank)).shape == (3, 5)
+
+
+def test_adasum_grouped_never_fuses():
+    """grouped_allreduce with Adasum: per-tensor results must equal solo
+    results exactly (a fused buffer would change every inner product)."""
+    rng = np.random.RandomState(5)
+    n = hvd.size()
+    shapes = [(7,), (11,), (64,)]
+    per_rank = [rng.randn(n, *s).astype(np.float32) for s in shapes]
+
+    def grouped(*ts):
+        return tuple(
+            ops.grouped_allreduce([t[0] for t in ts], op=ops.Adasum)
+        )
+
+    outs = jax.jit(
+        jax.shard_map(
+            grouped, mesh=hvd.mesh(),
+            in_specs=tuple(P(hvd.AXIS_NAME) for _ in shapes),
+            out_specs=tuple(P() for _ in shapes), check_vma=False,
+        )
+    )(*[jnp.asarray(t) for t in per_rank])
+    for t, out in zip(per_rank, outs):
+        expected = _adasum_tree_np([t[r].reshape(-1) for r in range(n)])
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(-1), expected, rtol=2e-4, atol=1e-5
+        )
+
+
+def test_adasum_eager_path():
+    n = hvd.size()
+    rng = np.random.RandomState(6)
+    per_rank = rng.randn(n, 24).astype(np.float32)
+    out = hvd.allreduce(jnp.asarray(per_rank), op=hvd.Adasum)
+    expected = _adasum_tree_np([per_rank[r] for r in range(n)])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_adasum_gather_tree_on_non_power_of_two_world():
+    """6-device sub-mesh exercises the all_gather pairwise-tree branch
+    (the butterfly requires a power-of-two world)."""
+    devs = jax.devices()[:6]
+    mesh = jax.sharding.Mesh(np.asarray(devs), ("six",))
+    rng = np.random.RandomState(8)
+    per_rank = rng.randn(6, 17).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda a: ops.allreduce(a[0], op=ops.Adasum, axis_name="six"),
+            mesh=mesh, in_specs=P("six"), out_specs=P(), check_vma=False,
+        )
+    )
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    expected = _adasum_tree_np([per_rank[r] for r in range(6)])
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=1e-5)
+
+
+def test_adasum_tuple_axis():
+    """Hierarchical (dcn, ici) tuple axis takes the gather-tree path over
+    the combined 2x4 = 8 ranks in mesh order."""
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = jax.sharding.Mesh(devs, ("dcn", "ici"))
+    rng = np.random.RandomState(9)
+    per_rank = rng.randn(8, 9).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda a: ops.allreduce(
+                a.reshape(-1, 9)[0], op=ops.Adasum, axis_name=("dcn", "ici")
+            ),
+            mesh=mesh, in_specs=P(("dcn", "ici")), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    expected = _adasum_tree_np([per_rank[r] for r in range(8)])
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=1e-5)
+
+
+def test_adasum_fp16_wire():
+    """Compression.fp16 + Adasum: 16-bit wire, result stays replicated and
+    close to the fp32 tree (both operands of every pair are quantized, so
+    rank symmetry survives quantization)."""
+    n = hvd.size()
+    rng = np.random.RandomState(10)
+    per_rank = rng.randn(n, 32).astype(np.float32)
+    f = _smap(
+        lambda a: ops.allreduce(
+            a[0], op=ops.Adasum, compression=hvd.Compression.fp16
+        ),
+        out_specs=P(hvd.AXIS_NAME),
+    )
+    stacked = np.asarray(f(jnp.asarray(per_rank))).reshape(n, 32)
+    for r in range(1, n):
+        np.testing.assert_allclose(stacked[r], stacked[0], rtol=1e-5,
+                                   atol=1e-5)
+    expected = _adasum_tree_np([per_rank[r] for r in range(n)])
+    np.testing.assert_allclose(stacked[0], expected, rtol=0.02, atol=0.02)
+
+
+def test_adasum_rejects_int8():
+    with pytest.raises(ValueError, match="wire-format"):
+        _smap(
+            lambda a: ops.allreduce(
+                a[0], op=ops.Adasum, compression=hvd.Compression.int8
+            )
+        )(jnp.zeros((hvd.size(), 8), jnp.float32))
+
+
+def test_adasum_distributed_optimizer_learns():
+    n = hvd.size()
+    rng = np.random.RandomState(7)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(n * 8, 16).astype(np.float32)
+    y = x @ w_true
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch[0] @ params["w"] - batch[1]) ** 2)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.05), op=hvd.Adasum)
+    params = {"w": jnp.zeros((16, 4), np.float32)}
+    st = tx.init(params)
+    step = hvd.make_train_step(loss_fn, tx, donate=False)
+    losses = []
+    for _ in range(40):
+        out = step(params, st, (jnp.asarray(x), jnp.asarray(y)))
+        params, st = out.params, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < 0.1 * losses[0], losses
