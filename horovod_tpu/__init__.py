@@ -52,6 +52,7 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     Average,
     Max,
     Min,
+    ProcessSet,
     Product,
     Sum,
 )

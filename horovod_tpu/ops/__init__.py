@@ -5,6 +5,7 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     Average,
     Max,
     Min,
+    ProcessSet,
     Product,
     Sum,
     adasum_allreduce,

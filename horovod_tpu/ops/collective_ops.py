@@ -77,6 +77,77 @@ def _reduce(x: jax.Array, op: _ReduceOp, axis_name) -> jax.Array:
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+class ProcessSet:
+    """A static subset of ranks that collectives can run over — the API the
+    Horovod project added in 0.22 (``hvd.ProcessSet``), TPU-native.
+
+    Where Horovod builds a sub-communicator per set, XLA collectives take
+    ``axis_index_groups``: a ProcessSet lowers to a partition of the mesh
+    axis into [the member group] + singleton groups for everyone else, so
+    members reduce together and non-members pass through unchanged —
+    no communicator state, no registration step, works inside any
+    compiled program.
+
+    Under SPMD every rank executes the same program, so "non-members don't
+    call the op" (Horovod's model) becomes "non-members run the identity";
+    results on non-member ranks are their own inputs.
+    """
+
+    def __init__(self, ranks):
+        rs = sorted(int(r) for r in ranks)
+        if len(rs) != len(set(rs)):
+            raise ValueError(f"duplicate ranks in process set: {ranks}")
+        if not rs:
+            raise ValueError("a process set needs at least one rank")
+        if rs[0] < 0:
+            raise ValueError(f"negative rank in process set: {ranks}")
+        self.ranks = tuple(rs)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ProcessSet{self.ranks}"
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank_of(self, global_rank: int) -> int:
+        """Set-local rank of ``global_rank``, or -1 if not a member."""
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def included(self, global_rank: int) -> bool:
+        return global_rank in self.ranks
+
+    def groups(self, world_size: int) -> list[list[int]]:
+        """The axis_index_groups partition: members together, everyone
+        else alone."""
+        if self.ranks[-1] >= world_size:
+            raise ValueError(
+                f"process set {self.ranks} exceeds world size {world_size}"
+            )
+        member = set(self.ranks)
+        return [list(self.ranks)] + [
+            [r] for r in range(world_size) if r not in member
+        ]
+
+    def groups_for_axis(self, axis_name) -> list[list[int]]:
+        """``groups()`` for a traced mesh axis — the one place that
+        rejects tuple axes (axis_index_groups needs a single axis) and
+        bounds-checks the ranks against the axis size."""
+        if isinstance(axis_name, (tuple, list)):
+            raise ValueError(
+                "process_set collectives need a single mesh axis; flatten "
+                "the hierarchical axes first"
+            )
+        return self.groups(lax.axis_size(axis_name))
+
+    def member_mask(self, axis_name) -> jax.Array:
+        """Traced predicate: is the executing rank a member?"""
+        idx = lax.axis_index(axis_name)
+        return jnp.any(idx == jnp.asarray(self.ranks))
+
+
 def _adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
     """The Adasum combination of two flat fp32 gradients:
 
@@ -151,6 +222,33 @@ def adasum_allreduce(
     return v.reshape(tensor.shape).astype(orig_dtype)
 
 
+def _process_set_allreduce(
+    tensor: jax.Array,
+    ps: ProcessSet,
+    op: _ReduceOp,
+    axis_name,
+    compression: Compressor,
+) -> jax.Array:
+    """Members reduce together (one axis_index_groups collective);
+    non-members receive their input unchanged."""
+    if op not in (Sum, Average, Min, Max):
+        raise ValueError(f"process_set supports Sum/Average/Min/Max, not {op}")
+    groups = ps.groups_for_axis(axis_name)
+    compressed, ctx = compression.compress(tensor)
+    if op in (Min, Max):
+        fn = lax.pmin if op is Min else lax.pmax
+        red = fn(compressed, axis_name, axis_index_groups=groups)
+    else:
+        red = lax.psum(compressed, axis_name, axis_index_groups=groups)
+        if op is Average:
+            # Non-members' singleton psum is their own value; dividing it
+            # would corrupt the pass-through, so the divide is member-only.
+            red = jnp.where(
+                ps.member_mask(axis_name), red / ps.size(), compressed
+            )
+    return compression.decompress(red, ctx)
+
+
 def allreduce(
     tensor: jax.Array,
     average: bool | None = None,
@@ -158,6 +256,7 @@ def allreduce(
     op: _ReduceOp = Sum,
     axis_name=AXIS_NAME,
     compression: Compressor = Compression.none,
+    process_set: ProcessSet | None = None,
 ) -> jax.Array:
     """All-reduce ``tensor`` over ``axis_name``.
 
@@ -171,9 +270,23 @@ def allreduce(
     ``lax.psum`` already differentiates to ``psum`` — the hand-registered
     gradient of the reference (horovod/tensorflow/mpi_ops.py:93-104) is
     automatic here.
+
+    ``process_set`` restricts the reduction to a rank subset (Horovod
+    ≥0.22 API); non-member ranks receive their input unchanged.
     """
     if average is not None:
         op = Average if average else Sum
+    if process_set is not None:
+        if op is Adasum or callable(
+            getattr(compression, "quantized_allreduce", None)
+        ):
+            raise ValueError(
+                "process_set does not compose with Adasum or wire-format "
+                "compressors; use Sum/Average/Min/Max with none/fp16/bf16"
+            )
+        return _process_set_allreduce(
+            tensor, process_set, op, axis_name, compression
+        )
     if op in (Min, Max, Product):
         return _reduce(tensor, op, axis_name)
     if op is Adasum:
@@ -205,6 +318,7 @@ def grouped_allreduce(
     axis_name=AXIS_NAME,
     compression: Compressor = Compression.none,
     fusion_threshold_bytes: int | None = None,
+    process_set: ProcessSet | None = None,
 ) -> list[jax.Array]:
     """All-reduce many tensors as few fused transfers — Tensor Fusion.
 
@@ -225,7 +339,8 @@ def grouped_allreduce(
     return fusion.fused_apply(
         list(tensors),
         lambda flat: allreduce(
-            flat, op=op, axis_name=axis_name, compression=compression
+            flat, op=op, axis_name=axis_name, compression=compression,
+            process_set=process_set,
         ),
         threshold_bytes=fusion_threshold_bytes,
     )
@@ -254,6 +369,7 @@ def broadcast(
     root_rank: int,
     *,
     axis_name=AXIS_NAME,
+    process_set: ProcessSet | None = None,
 ) -> jax.Array:
     """Every rank receives ``root_rank``'s value of ``tensor``.
 
@@ -261,17 +377,35 @@ def broadcast(
     masked ``psum`` — ``where(rank == root, x, 0)`` then all-reduce — which
     XLA pattern-matches into an efficient ICI broadcast.  Works for every
     dtype (bool/int via bitcast-free select on zeros).
+
+    With ``process_set``, ``root_rank`` must be a member; member ranks
+    receive the root's value, non-members their own input.
     """
+    if process_set is not None and not process_set.included(root_rank):
+        raise ValueError(
+            f"broadcast root_rank {root_rank} is not in {process_set!r}"
+        )
     # lax.axis_index natively combines tuple axes row-major, so the
     # hierarchical (dcn, ici) form needs no special case: ranks follow the
     # mesh's device order.
     idx = lax.axis_index(axis_name)
     mask = idx == root_rank
-    if jnp.issubdtype(tensor.dtype, jnp.bool_):
-        as_int = jnp.where(mask, tensor.astype(jnp.int8), jnp.zeros_like(tensor, jnp.int8))
-        return lax.psum(as_int, axis_name).astype(jnp.bool_)
-    masked = jnp.where(mask, tensor, jnp.zeros_like(tensor))
-    return lax.psum(masked, axis_name)
+    groups = None
+    if process_set is not None:
+        groups = process_set.groups_for_axis(axis_name)
+    wire = tensor
+    is_bool = jnp.issubdtype(tensor.dtype, jnp.bool_)
+    if is_bool:
+        wire = tensor.astype(jnp.int8)
+    masked = jnp.where(mask, wire, jnp.zeros_like(wire))
+    out = lax.psum(masked, axis_name, axis_index_groups=groups)
+    if process_set is not None:
+        # Non-members' singleton psum yields 0 (they are not the root);
+        # restore their own input.
+        out = jnp.where(process_set.member_mask(axis_name), out, wire)
+    if is_bool:
+        return out.astype(jnp.bool_)
+    return out
 
 
 def alltoall(
@@ -309,6 +443,12 @@ def reducescatter(
     return out
 
 
-def barrier(*, axis_name=AXIS_NAME) -> None:
-    """Synchronization barrier — a 1-element psum every rank must join."""
-    lax.psum(jnp.ones((), jnp.int32), axis_name)
+def barrier(*, axis_name=AXIS_NAME,
+            process_set: ProcessSet | None = None) -> None:
+    """Synchronization barrier — a 1-element psum every rank must join
+    (members only, when a ``process_set`` is given)."""
+    groups = (
+        process_set.groups_for_axis(axis_name)
+        if process_set is not None else None
+    )
+    lax.psum(jnp.ones((), jnp.int32), axis_name, axis_index_groups=groups)

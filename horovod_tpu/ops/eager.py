@@ -65,6 +65,7 @@ class _PendingOp:
     sizes: tuple[int, ...] | None = None   # ragged allgather per-rank dim-0 sizes
     topk: TopKCompressor | None = None
     group_id: int | None = None            # caller-delimited fusion group
+    process_set: Any = None                # ProcessSet restricting the op
     enqueued_at: float = 0.0
 
 
@@ -256,7 +257,8 @@ class EagerEngine:
         if p.op is collective_ops.Adasum:
             # Per-tensor inner products: never share a fused buffer.
             return ("solo", p.handle)
-        base = ("ar", p.op.name, p.compression, str(p.tensor.dtype))
+        ps = p.process_set.ranks if p.process_set is not None else None
+        base = ("ar", p.op.name, p.compression, str(p.tensor.dtype), ps)
         if jax.process_count() > 1:
             return base + (
                 ("grp", p.group_id) if p.group_id is not None else ("solo", p.handle),
@@ -501,11 +503,13 @@ class EagerEngine:
             )
         )
 
-    def _allreduce_group_fn(self, op: _ReduceOp, compression) -> Any:
+    def _allreduce_group_fn(self, op: _ReduceOp, compression,
+                            process_set=None) -> Any:
         """One jitted program: concat per-rank flats → ONE collective →
         split.  This is the Horovod fusion buffer, compiled
         (reference operations.cc:999-1053 memcpys become XLA layout ops)."""
-        key = ("ar", op.name, compression)
+        ps_key = process_set.ranks if process_set is not None else None
+        key = ("ar", op.name, compression, ps_key)
         fn = self._dispatch_cache.get(key)
         if fn is None:
 
@@ -513,7 +517,8 @@ class EagerEngine:
                 flats = [x.reshape(-1) for x in xs]
                 buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
                 red = collective_ops.allreduce(
-                    buf, op=op, axis_name=self._axis, compression=compression
+                    buf, op=op, axis_name=self._axis, compression=compression,
+                    process_set=process_set,
                 )
                 outs, off = [], 0
                 for x in xs:
@@ -522,7 +527,12 @@ class EagerEngine:
                     off += n
                 return tuple(outs)
 
-            fn = self._shard_map(fused)
+            # Process-set results differ per rank (non-members keep their
+            # input), so they come back rank-major instead of replicated.
+            fn = self._shard_map(
+                fused,
+                out_specs=P(self._axis) if process_set is not None else P(),
+            )
             self._dispatch_cache[key] = fn
         return fn
 
@@ -535,12 +545,12 @@ class EagerEngine:
                 self.timeline.start(n, "ALLREDUCE", {"fused_with": len(group) - 1})
                 self.timeline.start(n, timeline_mod.DISPATCH)
         try:
-            fn = self._allreduce_group_fn(group[0].op, group[0].compression)
+            ps = group[0].process_set
+            fn = self._allreduce_group_fn(group[0].op, group[0].compression, ps)
             outs = fn(tuple(p.tensor.reshape(p.tensor.shape[0], -1) for p in group))
             for p, out in zip(group, outs):
-                self.handles.mark_dispatched(
-                    p.handle, out.reshape(p.tensor.shape[1:])
-                )
+                shape = p.tensor.shape if ps is not None else p.tensor.shape[1:]
+                self.handles.mark_dispatched(p.handle, out.reshape(shape))
             return outs[-1]
         except Exception as e:
             for p in group:
@@ -557,17 +567,27 @@ class EagerEngine:
             self.timeline.start(p.name, p.kind.upper())
         try:
             if p.kind == "broadcast":
-                key = ("bc", int(p.root_rank))
+                ps = p.process_set
+                ps_key = ps.ranks if ps is not None else None
+                key = ("bc", int(p.root_rank), ps_key)
                 fn = self._dispatch_cache.get(key)
                 if fn is None:
                     root = int(p.root_rank)
 
                     def bc(x):
-                        return collective_ops.broadcast(
-                            x[0], root, axis_name=self._axis
+                        out = collective_ops.broadcast(
+                            x[0], root, axis_name=self._axis, process_set=ps
                         )
+                        # Rank-major output keeps the leading rank axis so
+                        # the stacked global shape is [size, *shape].
+                        return out[None] if ps is not None else out
 
-                    fn = self._shard_map(bc)
+                    # With a process set the output differs per rank
+                    # (members get root's value, others keep their own), so
+                    # it stays rank-major instead of collapsing to one copy.
+                    fn = self._shard_map(
+                        bc, out_specs=P(self._axis) if ps is not None else P()
+                    )
                     self._dispatch_cache[key] = fn
                 self.handles.mark_dispatched(p.handle, fn(p.tensor))
             elif p.kind == "allgather":
@@ -580,14 +600,31 @@ class EagerEngine:
                     fn = self._shard_map(ag)
                     self._dispatch_cache["ag"] = fn
                 gathered = fn(p.tensor)  # [size * padded_d0, rest]
+                member_ranks = (
+                    range(p.tensor.shape[0]) if p.process_set is None
+                    else p.process_set.ranks
+                )
                 if p.sizes is not None:
                     pad = p.tensor.shape[1]
                     pieces = []
-                    for r, s in enumerate(p.sizes):
+                    for r in member_ranks:
+                        s = p.sizes[r]
                         pieces.append(
                             lax.slice_in_dim(gathered, r * pad, r * pad + s, axis=0)
                         )
                     gathered = jnp.concatenate(pieces, axis=0)
+                elif p.process_set is not None:
+                    # Fixed per-rank dim 0: concatenate member blocks only.
+                    pad = p.tensor.shape[1]
+                    gathered = jnp.concatenate(
+                        [
+                            lax.slice_in_dim(
+                                gathered, r * pad, (r + 1) * pad, axis=0
+                            )
+                            for r in member_ranks
+                        ],
+                        axis=0,
+                    )
                 self.handles.mark_dispatched(p.handle, gathered)
             elif p.kind == "sparse":
                 topk = p.topk
@@ -660,9 +697,12 @@ def allreduce_async(
     op: _ReduceOp = Sum,
     compression=Compression.none,
     group_id: int | None = None,
+    process_set=None,
 ) -> int:
     """Async all-reduce of a rank-major tensor; returns a handle
-    (reference horovod/torch/mpi_ops.py:156-176)."""
+    (reference horovod/torch/mpi_ops.py:156-176).  ``process_set``
+    restricts the reduction to member ranks; non-member rows pass through
+    unchanged (Horovod ≥0.22 API)."""
     if average is not None:
         op = Average if average else Sum
     eng = _engine()
@@ -678,17 +718,22 @@ def allreduce_async(
             op=op,
             compression=compression,
             group_id=group_id,
+            process_set=process_set,
         )
     )
     return h
 
 
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
-              *, op: _ReduceOp = Sum, compression=Compression.none):
+              *, op: _ReduceOp = Sum, compression=Compression.none,
+              process_set=None):
     """Blocking all-reduce (reference horovod/torch/mpi_ops.py:60-109).
-    Returns the reduced tensor, fully replicated over the mesh."""
+    Returns the reduced tensor, fully replicated over the mesh.  With a
+    ``process_set`` the result differs per rank (non-members keep their
+    input), so it comes back rank-major ``[size, ...]``."""
     return synchronize(
-        allreduce_async(tensor, average, name, op=op, compression=compression)
+        allreduce_async(tensor, average, name, op=op, compression=compression,
+                        process_set=process_set)
     )
 
 
@@ -723,7 +768,8 @@ def sparse_allreduce(tensor, name: str | None = None, *, average: bool = False,
     )
 
 
-def allgather_async(tensors, name: str | None = None) -> int:
+def allgather_async(tensors, name: str | None = None, *,
+                    process_set=None) -> int:
     """Async allgather; ``tensors`` is rank-major or a list of per-rank
     tensors whose first dims may differ (reference allgather-with-unequal-
     first-dims, operations.cc:841-901 — size negotiation happens host-side
@@ -757,6 +803,11 @@ def allgather_async(tensors, name: str | None = None) -> int:
             sizes = None
     else:
         t = _as_rank_major(tensors, "allgather")
+    if process_set is not None and process_set.ranks[-1] >= basics.size():
+        raise ValueError(
+            f"process set {process_set.ranks} exceeds world size "
+            f"{basics.size()}"
+        )
     name = name or _auto_name("allgather")
     h = eng.handles.allocate(name)
     eng.enqueue(
@@ -766,22 +817,33 @@ def allgather_async(tensors, name: str | None = None) -> int:
             tensor=t,
             name=name,
             sizes=sizes,
+            process_set=process_set,
         )
     )
     return h
 
 
-def allgather(tensors, name: str | None = None):
-    return synchronize(allgather_async(tensors, name))
+def allgather(tensors, name: str | None = None, *, process_set=None):
+    """Blocking allgather.  With a ``process_set``, the result is the
+    concatenation of MEMBER ranks' slices only (set order)."""
+    return synchronize(allgather_async(tensors, name,
+                                       process_set=process_set))
 
 
-def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+def broadcast_async(tensor, root_rank: int, name: str | None = None, *,
+                    process_set=None) -> int:
     """Async broadcast of rank ``root_rank``'s slice to all
-    (reference horovod/torch/mpi_ops.py:318-405)."""
+    (reference horovod/torch/mpi_ops.py:318-405).  With a ``process_set``
+    the output is rank-major: members carry the root's value, non-members
+    their own input."""
     eng = _engine()
     t = _as_rank_major(tensor, "broadcast")
     if not 0 <= root_rank < basics.size():
         raise ValueError(f"root_rank {root_rank} outside [0, {basics.size()})")
+    if process_set is not None and not process_set.included(root_rank):
+        raise ValueError(
+            f"broadcast root_rank {root_rank} is not in {process_set!r}"
+        )
     name = name or _auto_name("broadcast")
     h = eng.handles.allocate(name)
     eng.enqueue(
@@ -791,13 +853,16 @@ def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
             tensor=t,
             name=name,
             root_rank=root_rank,
+            process_set=process_set,
         )
     )
     return h
 
 
-def broadcast(tensor, root_rank: int, name: str | None = None):
-    return synchronize(broadcast_async(tensor, root_rank, name))
+def broadcast(tensor, root_rank: int, name: str | None = None, *,
+              process_set=None):
+    return synchronize(broadcast_async(tensor, root_rank, name,
+                                       process_set=process_set))
 
 
 def poll(handle: int) -> bool:

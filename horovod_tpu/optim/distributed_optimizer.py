@@ -45,6 +45,7 @@ def allreduce_gradients(
     fusion_threshold_bytes: int | None = None,
     sparse: bool = False,
     sparse_ratio: float = 0.01,
+    process_set=None,
 ) -> Any:
     """All-reduce a gradient pytree over the mesh axis, fused.
 
@@ -55,6 +56,11 @@ def allreduce_gradients(
     each bucket is ONE psum (operations.cc:1916-1943's merge, compiled).
     """
     leaves, treedef = jax.tree.flatten(grads)
+    if sparse and process_set is not None:
+        raise ValueError(
+            "process_set does not compose with the top-k sparse path; "
+            "members-only sparse reduction needs a set-local allgather"
+        )
     if sparse:
         topk = TopKCompressor(ratio=sparse_ratio)
         reduced = [
@@ -68,6 +74,7 @@ def allreduce_gradients(
             axis_name=axis_name,
             compression=compression,
             fusion_threshold_bytes=fusion_threshold_bytes,
+            process_set=process_set,
         )
     return jax.tree.unflatten(treedef, reduced)
 
@@ -92,6 +99,7 @@ def DistributedOptimizer(
     sparse_ratio: float = 0.01,
     local: bool = False,
     backward_passes_per_step: int = 1,
+    process_set=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-averaged gradients.
 
@@ -136,6 +144,13 @@ def DistributedOptimizer(
                 "already defines its own wire — wrap TopKCompressor in "
                 "ErrorFeedback instead of combining the two flags."
             )
+        if process_set is not None:
+            raise ValueError(
+                "process_set does not compose with stateful compressors "
+                "(PowerSGD / ErrorFeedback): their collectives run over "
+                "the full axis — silent full-world mixing would corrupt "
+                "member updates"
+            )
 
     def init_fn(params):
         inner = optimizer.init(params)
@@ -162,6 +177,7 @@ def DistributedOptimizer(
                 fusion_threshold_bytes=fusion_threshold_bytes,
                 sparse=is_sparse,
                 sparse_ratio=sparse_ratio,
+                process_set=process_set,
             )
         updates, inner = optimizer.update(reduced, inner, params, **extra)
         if stateful:
