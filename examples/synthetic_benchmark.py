@@ -28,8 +28,18 @@ def main():
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument(
+        "--compression",
+        choices=["none", "fp16", "bf16", "int8", "powersgd", "ef-topk"],
+        default="none",
+        help="gradient compression on the wire (docs/compression.md)",
+    )
+    p.add_argument("--adasum", action="store_true",
+                   help="combine gradients with op=Adasum instead of Average")
     p.add_argument("--smoke", action="store_true")
     args = p.parse_args()
+    if args.adasum and args.compression in ("int8", "powersgd", "ef-topk"):
+        p.error("--adasum composes with none/fp16/bf16 compression only")
     if args.smoke:
         args.image_size, args.num_iters, args.num_batches_per_iter = 32, 2, 2
 
@@ -56,13 +66,29 @@ def main():
             logits, y
         ).mean()
 
-    tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
+    compression = {
+        "none": hvd.Compression.none,
+        "fp16": hvd.Compression.fp16,
+        "bf16": hvd.Compression.bf16,
+        "int8": hvd.Compression.int8,
+        "powersgd": hvd.PowerSGDCompressor(rank=4),
+        "ef-topk": hvd.ErrorFeedback(
+            hvd.ops.compression.TopKCompressor(ratio=0.01)
+        ),
+    }[args.compression]
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.01 * n, momentum=0.9),
+        compression=compression,
+        op=hvd.Adasum if args.adasum else hvd.Average,
+    )
     opt_state = tx.init(params)
     step = hvd.make_train_step(loss_fn, tx)
 
     if hvd.rank() == 0:
         print(f"Model: ResNet50  Batch size/chip: {args.batch_size}  "
-              f"Chips: {n}  Backend: {jax.default_backend()}")
+              f"Chips: {n}  Backend: {jax.default_backend()}  "
+              f"Compression: {args.compression}"
+              + ("  Op: Adasum" if args.adasum else ""))
 
     out = step(params, opt_state, (images, labels))  # compile + warmup
     params, opt_state = out.params, out.opt_state

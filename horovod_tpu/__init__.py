@@ -105,6 +105,7 @@ from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer  # noqa:
 from horovod_tpu.optim.zero import ZeroStepResult, make_zero_train_step  # noqa: F401
 from horovod_tpu.training import fit, make_eval_step  # noqa: F401
 from horovod_tpu.data import ShardedLoader, shard_indices  # noqa: F401
+from horovod_tpu.timeline import start_timeline, stop_timeline  # noqa: F401
 from horovod_tpu import ops  # noqa: F401
 
 __version__ = "0.1.0"

@@ -337,7 +337,8 @@ class EagerEngine:
         comp = getattr(p.compression, "__name__", None) or type(
             p.compression
         ).__name__
-        token = f"{p.op.name}:{comp}".encode()
+        ps = p.process_set.ranks if p.process_set is not None else ()
+        token = f"{p.op.name}:{comp}:{ps}".encode()
         import hashlib
 
         return int.from_bytes(hashlib.sha1(token).digest()[:7], "big")
@@ -431,6 +432,11 @@ class EagerEngine:
             self._tick.clear()
             try:
                 self.flush()
+                tl = self.timeline
+                if tl is not None and tl.mark_cycles:
+                    # hvd.start_timeline(mark_cycles=True) parity: one
+                    # instant per engine tick on a dedicated track.
+                    tl.instant("_engine", "CYCLE_START")
             except Exception:  # pragma: no cover - defensive: keep ticking
                 import traceback
 
@@ -600,26 +606,20 @@ class EagerEngine:
                     fn = self._shard_map(ag)
                     self._dispatch_cache["ag"] = fn
                 gathered = fn(p.tensor)  # [size * padded_d0, rest]
-                member_ranks = (
-                    range(p.tensor.shape[0]) if p.process_set is None
-                    else p.process_set.ranks
-                )
-                if p.sizes is not None:
+                if p.sizes is not None or p.process_set is not None:
+                    # One slice loop covers both the ragged case (per-rank
+                    # first dims) and the process-set case (member blocks
+                    # only): a fixed first dim is just sizes == (pad,)*n.
                     pad = p.tensor.shape[1]
-                    pieces = []
-                    for r in member_ranks:
-                        s = p.sizes[r]
-                        pieces.append(
-                            lax.slice_in_dim(gathered, r * pad, r * pad + s, axis=0)
-                        )
-                    gathered = jnp.concatenate(pieces, axis=0)
-                elif p.process_set is not None:
-                    # Fixed per-rank dim 0: concatenate member blocks only.
-                    pad = p.tensor.shape[1]
+                    sizes = p.sizes or (pad,) * p.tensor.shape[0]
+                    member_ranks = (
+                        range(p.tensor.shape[0]) if p.process_set is None
+                        else p.process_set.ranks
+                    )
                     gathered = jnp.concatenate(
                         [
                             lax.slice_in_dim(
-                                gathered, r * pad, (r + 1) * pad, axis=0
+                                gathered, r * pad, r * pad + sizes[r], axis=0
                             )
                             for r in member_ranks
                         ],
@@ -670,7 +670,13 @@ def _engine() -> EagerEngine:
     st = basics._require_init()
     with st.lock:
         if st.engine is None:
-            st.timeline = timeline_mod.maybe_create(st.config.timeline_file)
+            if st.timeline is None:
+                # A start_timeline() call before the first eager op may
+                # already have installed one — never clobber it with the
+                # (possibly unset) env config.
+                st.timeline = timeline_mod.maybe_create(
+                    st.config.timeline_file
+                )
             st.engine = EagerEngine(st.mesh, st.config, st.timeline)
         return st.engine
 

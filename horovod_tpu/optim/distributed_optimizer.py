@@ -151,6 +151,10 @@ def DistributedOptimizer(
                 "the full axis — silent full-world mixing would corrupt "
                 "member updates"
             )
+        if op not in (Sum, Average):
+            raise ValueError(
+                f"stateful compressors support op=Sum/Average, not {op}"
+            )
 
     def init_fn(params):
         inner = optimizer.init(params)

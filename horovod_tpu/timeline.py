@@ -48,9 +48,10 @@ class Timeline:
     timeline.cc flush cadence) or on close.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, mark_cycles: bool = False) -> None:
         self._lock = threading.Lock()
         self._path = path
+        self.mark_cycles = mark_cycles
         self._file: TextIO = open(path, "w")
         self._file.write("[\n")
         self._start = time.perf_counter()
@@ -172,7 +173,8 @@ def trace_annotation(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def maybe_create(path: str | None) -> Timeline | None:
+def maybe_create(path: str | None,
+                 mark_cycles: bool = False) -> Timeline | None:
     """Create a timeline if configured.  Rank-0-only in multi-host jobs
     (reference operations.cc:1614-1618 gates on is_coordinator)."""
     if not path:
@@ -182,4 +184,47 @@ def maybe_create(path: str | None) -> Timeline | None:
     dirname = os.path.dirname(path)
     if dirname:
         os.makedirs(dirname, exist_ok=True)
-    return Timeline(path)
+    return Timeline(path, mark_cycles=mark_cycles)
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> None:
+    """Start recording a timeline mid-run — the ``hvd.start_timeline``
+    API the Horovod project added in 0.20 (the reference generation could
+    only enable it via env var at init).
+
+    ``mark_cycles=True`` adds an instant event per engine cycle tick, the
+    same knob as upstream.  Rank-0 only in multi-host jobs (no-op
+    elsewhere); raises if a timeline is already active.
+    """
+    from horovod_tpu import basics
+
+    st = basics._require_init()
+    with st.lock:
+        if st.timeline is not None:
+            raise ValueError(
+                "a timeline is already active; call stop_timeline() first"
+            )
+        tl = maybe_create(path, mark_cycles=mark_cycles)
+        st.timeline = tl
+        if st.engine is not None and tl is not None:
+            st.engine.timeline = tl
+            if st.engine.controller is not None:
+                st.engine.controller.enable_tick_trace()
+
+
+def stop_timeline() -> None:
+    """Stop the active timeline and finalize its file (``hvd.stop_timeline``
+    parity).  Idempotent when none is active."""
+    from horovod_tpu import basics
+
+    st = basics._require_init()
+    with st.lock:
+        tl, st.timeline = st.timeline, None
+        if st.engine is not None:
+            st.engine.timeline = None
+            if st.engine.controller is not None and tl is not None:
+                # The drain site is gated on an active timeline; without
+                # this the rank-0 tick buffer would grow with no consumer.
+                st.engine.controller.enable_tick_trace(False)
+    if tl is not None:
+        tl.close()

@@ -75,3 +75,18 @@ def test_resnet50_smoke(tmp_path):
         ["--epochs", "1", "--smoke", "--batch-per-chip", "2",
          "--ckpt-dir", str(tmp_path)],
     )
+
+
+@pytest.mark.slow
+def test_synthetic_benchmark_compression_smoke():
+    """The benchmark example drives every compression flag end-to-end
+    (--smoke keeps it tiny); exercises the full flag surface of
+    docs/compression.md."""
+    run_example(
+        "synthetic_benchmark.py",
+        ["--smoke", "--batch-size", "2", "--compression", "powersgd"],
+    )
+    run_example(
+        "synthetic_benchmark.py",
+        ["--smoke", "--batch-size", "2", "--adasum"],
+    )

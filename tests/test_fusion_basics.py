@@ -218,3 +218,63 @@ def test_hierarchical_allreduce_engine(monkeypatch):
         monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE")
         monkeypatch.delenv("HOROVOD_TPU_HIERARCHY_LOCAL_SIZE")
         hvd.init()
+
+
+def test_start_timeline_before_first_eager_op(tmp_path):
+    """Regression: start_timeline() before the engine exists must survive
+    lazy engine creation (which installs the env-config timeline) and
+    record the first op."""
+    import json
+
+    import horovod_tpu as hvd
+
+    path = tmp_path / "pre_engine.json"
+    hvd.shutdown()
+    hvd.init()
+    try:
+        hvd.start_timeline(str(path))
+        x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+        hvd.allreduce(x, name="first.op")
+        hvd.stop_timeline()
+    finally:
+        hvd.shutdown()
+        hvd.init()
+    events = json.loads(path.read_text())
+    tracked = {
+        e["args"]["name"] for e in events if e["name"] == "process_name"
+    }
+    assert "first.op" in tracked
+
+
+def test_start_stop_timeline_mid_run(tmp_path):
+    """hvd.start_timeline / stop_timeline (Horovod >=0.20 API): recording
+    can begin and end mid-run, the file is valid Chrome-trace JSON covering
+    only the recorded window, and mark_cycles adds engine-tick instants."""
+    import json
+
+    import horovod_tpu as hvd
+
+    path = tmp_path / "mid.json"
+    x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+    hvd.allreduce(x, name="before.rec")          # outside the window
+    hvd.start_timeline(str(path), mark_cycles=True)
+    with pytest.raises(ValueError, match="already active"):
+        hvd.start_timeline(str(path))
+    try:
+        hvd.allreduce(x, name="inside.rec")
+        import time as _t
+
+        _t.sleep(0.05)                           # let a cycle tick fire
+    finally:
+        hvd.stop_timeline()
+    hvd.stop_timeline()                          # idempotent
+    hvd.allreduce(x, name="after.rec")           # must not crash or record
+    events = json.loads(path.read_text())
+    names = {e["name"] for e in events}
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "CYCLE_START" in names
+    tracked = {
+        e["args"]["name"] for e in events if e["name"] == "process_name"
+    }
+    assert "inside.rec" in tracked
+    assert "before.rec" not in tracked and "after.rec" not in tracked

@@ -355,3 +355,24 @@ def test_eager_engine_duplicate_name_errors(native_engine_world):
     hvd.synchronize(h1)
     with pytest.raises(RuntimeError, match="Duplicate tensor name"):
         hvd.synchronize(h2)
+
+
+def test_eager_engine_native_process_sets_do_not_cross_fuse(
+    native_engine_world,
+):
+    """Regression: the controller's fusion token must separate different
+    ProcessSets — cross-fused sets would all dispatch under group[0]'s set
+    (wrong numerics, no error)."""
+    n = hvd.size()
+    a_set = hvd.ProcessSet([0, 1])
+    b_set = hvd.ProcessSet([2, 3])
+    ta = hvd.per_rank(lambda r: jnp.full((8,), float(r)))
+    tb = hvd.per_rank(lambda r: jnp.full((8,), float(10 * r)))
+    ha = hvd.allreduce_async(ta, average=True, process_set=a_set)
+    hb = hvd.allreduce_async(tb, average=True, process_set=b_set)
+    oa = np.asarray(hvd.synchronize(ha))
+    ob = np.asarray(hvd.synchronize(hb))
+    np.testing.assert_allclose(oa[0], np.full((8,), 0.5))
+    np.testing.assert_allclose(oa[4], np.full((8,), 4.0))   # pass-through
+    np.testing.assert_allclose(ob[2], np.full((8,), 25.0))
+    np.testing.assert_allclose(ob[0], np.full((8,), 0.0))   # pass-through
