@@ -60,7 +60,33 @@ class EagerDistributedOptimizer:
         sparse_ratio: float = 0.01,
         local: bool = False,
         backward_passes_per_step: int = 1,
+        op=None,
     ):
+        """``op=hvd.Adasum`` switches gradient combination to the
+        scaled-sensitivity rule (torch ``DistributedOptimizer(op=hvd.Adasum)``
+        parity); default is the reference's averaging.  ``process_set`` is
+        deliberately absent: this class drives ONE replicated parameter
+        copy, and subset reductions make ranks diverge — use the compiled
+        ``DistributedOptimizer(process_set=...)`` inside shard_map with
+        rank-major params for that."""
+        from horovod_tpu.ops.collective_ops import Adasum
+
+        if op is not None and op is not Adasum:
+            raise ValueError(
+                "op= accepts hvd.Adasum only (default is averaging)"
+            )
+        if op is not None and is_sparse:
+            raise ValueError("Adasum does not compose with the sparse path")
+        if op is not None and callable(
+            getattr(compression, "quantized_allreduce", None)
+        ):
+            # Fail here, not asynchronously inside the first step()'s
+            # handle drain, far from the misconfiguration.
+            raise ValueError(
+                "Adasum does not support wire-format compressors (int8); "
+                "use Compression.fp16/bf16"
+            )
+        self.op = op
         self.tx = optimizer
         self.compression = compression
         self.is_sparse = is_sparse
@@ -123,8 +149,11 @@ class EagerDistributedOptimizer:
                         g, name=name, average=True, ratio=self.sparse_ratio
                     )
                 else:
+                    from horovod_tpu.ops.collective_ops import Average
+
                     h = eager_ops.allreduce_async(
-                        g, average=True, name=name,
+                        g, name=name,
+                        op=self.op if self.op is not None else Average,
                         compression=self.compression,
                     )
                 self._handles.append((name, h))

@@ -229,3 +229,44 @@ def test_adasum_distributed_optimizer_learns():
         params, st = out.params, out.opt_state
         losses.append(float(out.loss))
     assert losses[-1] < 0.1 * losses[0], losses
+
+
+def test_eager_optimizer_adasum():
+    """EagerDistributedOptimizer(op=hvd.Adasum): the hook-style path drives
+    Adasum wire and still learns."""
+    from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer
+
+    n = hvd.size()
+    rng = np.random.RandomState(12)
+    x = rng.randn(n * 4, 8).astype(np.float32)
+    w_true = rng.randn(8, 2).astype(np.float32)
+    y = x @ w_true
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch[0] @ params["w"] - batch[1]) ** 2)
+
+    opt = EagerDistributedOptimizer(optax.sgd(0.05), op=hvd.Adasum)
+    params = {"w": jnp.zeros((8, 2), np.float32)}
+    st = opt.init(params)
+    first = None
+    for _ in range(30):
+        opt.backward(loss_fn, params, (jnp.asarray(x), jnp.asarray(y)))
+        params, st = opt.step(params, st)
+        loss = float(opt.last_loss())
+        first = first if first is not None else loss
+    assert loss < 0.1 * first, (first, loss)
+    with pytest.raises(ValueError, match="Adasum only"):
+        EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Sum)
+    with pytest.raises(ValueError, match="sparse"):
+        EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                  is_sparse=True)
+
+
+def test_eager_optimizer_adasum_int8_rejected_at_construction():
+    from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer
+
+    with pytest.raises(ValueError, match="wire-format"):
+        EagerDistributedOptimizer(
+            optax.sgd(0.1), op=hvd.Adasum,
+            compression=hvd.Compression.int8,
+        )
