@@ -62,22 +62,23 @@ class EagerDistributedOptimizer:
         backward_passes_per_step: int = 1,
         op=None,
     ):
-        """``op=hvd.Adasum`` switches gradient combination to the
-        scaled-sensitivity rule (torch ``DistributedOptimizer(op=hvd.Adasum)``
-        parity); default is the reference's averaging.  ``process_set`` is
-        deliberately absent: this class drives ONE replicated parameter
-        copy, and subset reductions make ranks diverge — use the compiled
-        ``DistributedOptimizer(process_set=...)`` inside shard_map with
-        rank-major params for that."""
-        from horovod_tpu.ops.collective_ops import Adasum
+        """``op=`` selects the gradient combination — ``hvd.Average``
+        (default), ``hvd.Sum``, or ``hvd.Adasum`` (the scaled-sensitivity
+        rule; torch ``DistributedOptimizer(op=hvd.Adasum)`` parity).
+        ``process_set`` is deliberately absent: this class drives ONE
+        replicated parameter copy, and subset reductions make ranks
+        diverge — use the compiled ``DistributedOptimizer(process_set=...)``
+        inside shard_map with rank-major params for that."""
+        from horovod_tpu.ops.collective_ops import Adasum, Average, Sum
 
-        if op is not None and op is not Adasum:
+        op = Average if op is None else op
+        if op not in (Sum, Average, Adasum):
             raise ValueError(
-                "op= accepts hvd.Adasum only (default is averaging)"
+                f"op= accepts hvd.Sum / hvd.Average / hvd.Adasum, got {op}"
             )
-        if op is not None and is_sparse:
+        if op is Adasum and is_sparse:
             raise ValueError("Adasum does not compose with the sparse path")
-        if op is not None and callable(
+        if op is Adasum and callable(
             getattr(compression, "quantized_allreduce", None)
         ):
             # Fail here, not asynchronously inside the first step()'s
@@ -149,11 +150,8 @@ class EagerDistributedOptimizer:
                         g, name=name, average=True, ratio=self.sparse_ratio
                     )
                 else:
-                    from horovod_tpu.ops.collective_ops import Average
-
                     h = eager_ops.allreduce_async(
-                        g, name=name,
-                        op=self.op if self.op is not None else Average,
+                        g, name=name, op=self.op,
                         compression=self.compression,
                     )
                 self._handles.append((name, h))

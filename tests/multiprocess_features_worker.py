@@ -1,0 +1,64 @@
+"""Worker for the round-2 feature coverage under REAL process separation:
+ProcessSet subset collectives and the Adasum butterfly, each crossing
+actual OS-process boundaries (3 workers × 1 CPU device each).
+
+Launched by tests/test_multiprocess.py with the usual coordination env
+(HOROVOD_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID).  Prints
+``WORKER_OK {json}`` on success.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    me = jax.process_index()
+    assert n == 3, f"this worker expects a 3-rank world, got {n}"
+
+    # --- ProcessSet {0, 2}: members average ACROSS processes 0 and 2;
+    # rank 1 (its own process) passes through untouched.
+    ps = hvd.ProcessSet([0, 2])
+    x = hvd.from_per_rank(
+        [np.full((4,), float(10 * (r + 1)), np.float32) for r in range(n)]
+    )
+    out = hvd.allreduce(x, average=True, process_set=ps, name="ps.mp")
+    mine = np.asarray(out.addressable_shards[0].data).reshape(-1)[:4]
+    # members: mean(10, 30) = 20; non-member rank 1's pass-through is also
+    # 20 by coincidence — the second set below disambiguates.
+    assert np.allclose(mine, 20.0), (me, mine)
+
+    ps2 = hvd.ProcessSet([1, 2])
+    out2 = hvd.allreduce(x, average=True, process_set=ps2, name="ps2.mp")
+    mine2 = np.asarray(out2.addressable_shards[0].data).reshape(-1)[:4]
+    expected2 = 10.0 if me == 0 else 25.0      # mean(20, 30) = 25
+    assert np.allclose(mine2, expected2), (me, mine2)
+
+    # --- Adasum across processes: orthogonal per-rank gradients must ADD
+    # (gather-tree path: n == 3 is not a power of two).
+    g = hvd.from_per_rank(
+        [np.eye(3, dtype=np.float32)[r] * (r + 1.0) for r in range(n)]
+    )
+    ad = hvd.allreduce(g, op=hvd.Adasum, name="adasum.mp")
+    local = np.asarray(jax.device_get(ad)).reshape(-1)[:3]
+    assert np.allclose(local, [1.0, 2.0, 3.0], atol=1e-5), local
+
+    hvd.shutdown()
+    print("WORKER_OK " + json.dumps({"rank": me, "size": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -255,8 +255,11 @@ def test_eager_optimizer_adasum():
         loss = float(opt.last_loss())
         first = first if first is not None else loss
     assert loss < 0.1 * first, (first, loss)
-    with pytest.raises(ValueError, match="Adasum only"):
-        EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Sum)
+    # Explicitly passing the reference's defaults must work, not raise.
+    EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Sum)
+    EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Average)
+    with pytest.raises(ValueError, match="accepts hvd"):
+        EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Min)
     with pytest.raises(ValueError, match="sparse"):
         EagerDistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
                                   is_sparse=True)
