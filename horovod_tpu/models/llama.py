@@ -52,6 +52,9 @@ class LlamaConfig:
     attn_impl: str = "dense"  # dense | blockwise | ring | ulysses | ulysses_flash | flash
     attn_block_size: int = 512
     remat: bool = True                 # jax.checkpoint each scanned layer
+    # Chunked fused linear+cross-entropy (ops/fused_xent.py): loss without
+    # the [B·L, V] logits tensor; None keeps the plain path.
+    fused_loss_chunk: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -202,12 +205,15 @@ def forward(
     *,
     positions_offset: int | jax.Array = 0,
     sp_axis: str | None = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Token ids [B, L] → logits [B, L, V].
 
     ``positions_offset``: global position of tokens[:, 0] (nonzero on
     sequence shards).  ``sp_axis``: mesh axis name for ring/ulysses
     attention (call under shard_map with the sequence axis sharded).
+    ``return_hidden=True`` stops after the final norm ([B, L, D]) so the
+    fused loss can stream the vocab projection itself.
     """
     b, l = tokens.shape
     dt = cfg.dtype
@@ -238,6 +244,8 @@ def forward(
 
     x, _ = lax.scan(layer, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return x
     logits = x @ params["lm_head"].astype(dt)
     return logits.astype(jnp.float32)
 
@@ -246,8 +254,24 @@ def loss_fn(
     params: dict, batch: tuple[jax.Array, jax.Array], cfg: LlamaConfig,
     **fw_kwargs,
 ) -> jax.Array:
-    """Next-token cross-entropy; batch = (tokens [B, L], targets [B, L])."""
+    """Next-token cross-entropy; batch = (tokens [B, L], targets [B, L]).
+
+    With ``cfg.fused_loss_chunk`` the vocab projection and the softmax run
+    chunk-by-chunk (ops/fused_xent.py) — same math, no [B·L, V] logits
+    residency."""
     tokens, targets = batch
+    if cfg.fused_loss_chunk:
+        from horovod_tpu.ops.fused_xent import fused_linear_cross_entropy
+
+        hidden = forward(params, tokens, cfg, return_hidden=True,
+                         **fw_kwargs)
+        b, l, d = hidden.shape
+        return fused_linear_cross_entropy(
+            hidden.reshape(b * l, d),
+            params["lm_head"].astype(cfg.dtype),
+            targets.reshape(-1),
+            chunk_size=cfg.fused_loss_chunk,
+        )
     logits = forward(params, tokens, cfg, **fw_kwargs)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,118 @@
+"""Fused linear + cross-entropy (ops/fused_xent.py): exact-math equality
+with the materialized oracle, value AND gradient, across chunk layouts,
+plus the Llama integration and the data-parallel train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import llama
+from horovod_tpu.ops.fused_xent import (
+    fused_linear_cross_entropy,
+    reference_cross_entropy,
+)
+
+
+@pytest.mark.parametrize(
+    "n,d,v,chunk",
+    [
+        (16, 8, 32, 32),     # one chunk == V
+        (16, 8, 32, 8),      # V divisible by chunk
+        (16, 8, 37, 8),      # ragged final chunk (V % chunk != 0)
+        (16, 8, 32, 100),    # chunk > V (clamped)
+        (5, 4, 3, 2),        # tiny odd everything
+    ],
+)
+def test_fused_xent_matches_oracle(n, d, v, chunk):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d).astype(np.float32)) * 3.0
+    w = jnp.asarray(rng.randn(d, v).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, v, size=(n,)))
+    fused = fused_linear_cross_entropy(x, w, t, chunk_size=chunk)
+    ref = reference_cross_entropy(x, w, t)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-6)
+
+
+def test_fused_xent_gradients_match_oracle():
+    rng = np.random.RandomState(1)
+    n, d, v = 24, 16, 50
+    x = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(d, v).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, v, size=(n,)))
+    gx_f, gw_f = jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, t, chunk_size=16),
+        argnums=(0, 1),
+    )(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: reference_cross_entropy(x, w, t), argnums=(0, 1)
+    )(x, w)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_r),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_xent_extreme_logits_stable():
+    """Online logsumexp must survive logits far outside exp() range."""
+    x = jnp.asarray([[300.0], [-300.0]], jnp.float32)
+    w = jnp.asarray([[1.0, -1.0, 0.5]], jnp.float32)
+    t = jnp.asarray([0, 1])
+    fused = float(fused_linear_cross_entropy(x, w, t, chunk_size=2))
+    ref = float(reference_cross_entropy(x, w, t))
+    assert np.isfinite(fused)
+    np.testing.assert_allclose(fused, ref, rtol=1e-6)
+
+
+def test_llama_fused_loss_matches_plain():
+    # fp32 compute so the comparison is exact: in bf16 the paths differ by
+    # rounding only (the fused matmul accumulates fp32 via
+    # preferred_element_type; the plain path's bf16 logits round first).
+    cfg_plain = llama.llama_tiny(dtype=jnp.float32)
+    cfg_fused = llama.llama_tiny(dtype=jnp.float32, fused_loss_chunk=64)
+    params = llama.init_params(cfg_plain, jax.random.key(0))
+    tok = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                             cfg_plain.vocab_size)
+    tgt = jax.random.randint(jax.random.key(2), (2, 16), 0,
+                             cfg_plain.vocab_size)
+    plain = float(llama.loss_fn(params, (tok, tgt), cfg_plain))
+    fused = float(llama.loss_fn(params, (tok, tgt), cfg_fused))
+    np.testing.assert_allclose(fused, plain, rtol=2e-5)
+    # Gradients too (the training path).
+    gp = jax.grad(llama.make_loss_fn(cfg_plain))(params, (tok, tgt))
+    gf = jax.grad(llama.make_loss_fn(cfg_fused))(params, (tok, tgt))
+    for kp, a in jax.tree.flatten_with_path(gp)[0]:
+        b = gf
+        for k in kp:
+            b = b[getattr(k, "key", getattr(k, "idx", None))]
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=str(kp),
+        )
+
+
+def test_llama_fused_loss_trains_on_mesh():
+    cfg = llama.llama_tiny(fused_loss_chunk=64)
+    n = hvd.size()
+    params = llama.init_params(cfg, jax.random.key(3))
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2))
+    st = tx.init(params)
+    step = hvd.make_train_step(llama.make_loss_fn(cfg), tx, donate=False)
+    tok = jax.random.randint(jax.random.key(4), (2 * n, 16), 0,
+                             cfg.vocab_size)
+    losses = []
+    for _ in range(8):
+        out = step(params, st, (tok, tok))
+        params, st = out.params, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_fused_xent_rejects_bad_chunk():
+    x = jnp.zeros((2, 4), jnp.float32)
+    w = jnp.zeros((4, 8), jnp.float32)
+    t = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="positive"):
+        fused_linear_cross_entropy(x, w, t, chunk_size=-1)
