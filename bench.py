@@ -215,8 +215,13 @@ def _bench_resnet(hvd, on_tpu: bool) -> dict:
     }
 
 
-def _bench_llama(hvd, on_tpu: bool) -> dict:
-    """Tokens/sec/chip + MFU on the flagship transformer (flash attention)."""
+def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
+    """Tokens/sec/chip + MFU on the flagship transformer (flash attention).
+
+    ``fused_loss=True`` re-times the identical model with the chunked
+    fused linear+cross-entropy (no [B·L, V] logits residency,
+    ops/fused_xent.py) so the A/B lands in the bench record.
+    """
     from horovod_tpu.models import llama
 
     n = hvd.size()
@@ -224,11 +229,14 @@ def _bench_llama(hvd, on_tpu: bool) -> dict:
         cfg = llama.llama_tiny(
             vocab_size=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
             ffn_dim=4096, max_seq_len=2048, attn_impl="flash", remat=False,
+            fused_loss_chunk=8192 if fused_loss else None,
         )
         batch_per_chip, seq = 4, 2048
         iters, batches = 3, 8
     else:
-        cfg = llama.llama_tiny(attn_impl="flash")
+        cfg = llama.llama_tiny(
+            attn_impl="flash", fused_loss_chunk=64 if fused_loss else None
+        )
         batch_per_chip, seq = 2, 128
         iters, batches = 1, 1
     loss = llama.make_loss_fn(cfg)
@@ -250,6 +258,15 @@ def _bench_llama(hvd, on_tpu: bool) -> dict:
         return r.loss
 
     steps_per_sec = _time_loop(one, iters, batches)
+    if fused_loss:
+        # tokens/sec only: cost_analysis() would count the fused path's
+        # remat-recomputed chunk logits as flops, so an "MFU" here would
+        # not be comparable to the plain arm's — the honest A/B is speed.
+        return {
+            "llama_fused_loss_tokens_per_sec_per_chip": round(
+                steps_per_sec * batch_per_chip * seq, 1
+            ),
+        }
     return {
         "llama_tokens_per_sec_per_chip": round(
             steps_per_sec * batch_per_chip * seq, 1
@@ -257,6 +274,10 @@ def _bench_llama(hvd, on_tpu: bool) -> dict:
         "llama_mfu": _mfu(flops, steps_per_sec),
         "llama_params": llama.num_params(cfg),
     }
+
+
+def _bench_llama_fused(hvd, on_tpu: bool) -> dict:
+    return _bench_llama(hvd, on_tpu, fused_loss=True)
 
 
 def _bench_fusion(hvd, on_tpu: bool) -> dict:
@@ -338,7 +359,7 @@ def main() -> None:
         extras["tpu_unavailable_fell_back_to_cpu"] = True
     # Optional sub-benchmarks, each fenced by the remaining time budget so
     # the primary JSON line is never lost to a driver timeout.
-    for fn in (_bench_llama, _bench_fusion):
+    for fn in (_bench_llama, _bench_fusion, _bench_llama_fused):
         if time.monotonic() - t_start > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue

@@ -260,7 +260,9 @@ def loss_fn(
     chunk-by-chunk (ops/fused_xent.py) — same math, no [B·L, V] logits
     residency."""
     tokens, targets = batch
-    if cfg.fused_loss_chunk:
+    # `is not None`, not truthiness: fused_loss_chunk=0 must hit the op's
+    # chunk validation, not silently select the materialized path.
+    if cfg.fused_loss_chunk is not None:
         from horovod_tpu.ops.fused_xent import fused_linear_cross_entropy
 
         hidden = forward(params, tokens, cfg, return_hidden=True,
@@ -415,6 +417,55 @@ def decode_step(
     return logits, KVCache(k=ks, v=vs, length=pos + 1)
 
 
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """One sampling step on [B, V] logits → [B] token ids.
+
+    ``temperature<=0`` is greedy argmax (filters are irrelevant there).
+    ``top_k`` keeps the k largest logits; ``top_p`` keeps the smallest
+    nucleus whose cumulative probability reaches p (always ≥ 1 token);
+    both compose (top-k filter first, then the nucleus).  All branching is
+    trace-time, so the whole thing jits into the decode scan.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    v = logits.shape[-1]
+    use_k = top_k is not None and top_k < v
+    if top_p is not None and top_p < 1.0:
+        # ONE descending sort serves both filters (this runs per decoded
+        # token inside the scan — no second O(V log V) pass): top-k is a
+        # positional mask in sorted space, the nucleus is computed on the
+        # (possibly k-masked) sorted logits.
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if use_k:
+            pos = jnp.arange(v)[None, :]
+            sorted_desc = jnp.where(pos < top_k, sorted_desc, NEG_INF_LOGIT)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # Keep a sorted position while the mass BEFORE it is < p — the
+        # first token always qualifies (mass 0 < p).
+        keep = (csum - probs) < top_p
+        thresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= thresh, logits, NEG_INF_LOGIT)
+    elif use_k:
+        # top-k alone: lax.top_k gives the kth value without a full sort.
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits >= kth, logits, NEG_INF_LOGIT)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+NEG_INF_LOGIT = -1e30
+
+
 def generate(
     params: dict,
     prompt: jax.Array,
@@ -423,12 +474,15 @@ def generate(
     max_new_tokens: int,
     max_len: int | None = None,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     key: jax.Array | None = None,
 ) -> jax.Array:
     """Greedy (or sampled) generation: prompt [B, L] → [B, max_new_tokens].
 
     One prefill + one ``lax.scan`` of cached decode steps; jit-friendly
-    end to end (static shapes, no per-token retracing).
+    end to end (static shapes, no per-token retracing).  Sampling knobs:
+    ``temperature`` (0 = greedy), ``top_k``, ``top_p`` (nucleus).
     """
     b, l = prompt.shape
     max_len = max_len or (l + max_new_tokens)
@@ -442,10 +496,8 @@ def generate(
         key = jax.random.key(0)
 
     def pick(logits, k):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
+        return sample_logits(
+            logits, k, temperature=temperature, top_k=top_k, top_p=top_p
         ).astype(prompt.dtype)
 
     def step(carry, k):

@@ -285,3 +285,55 @@ def test_llama_generate_with_tp_sharded_params():
     assert toks.shape == (1, 4)
     t = np.asarray(toks)
     assert ((t >= 0) & (t < cfg.vocab_size)).all(), t
+
+
+def test_sample_logits_filters():
+    """top-k / top-p nucleus filtering: samples only ever come from the
+    allowed set; greedy and degenerate settings reduce to argmax."""
+    from horovod_tpu.models.llama import sample_logits
+
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 4.0]])
+    # greedy ignores filters
+    assert int(sample_logits(logits, jax.random.key(0))[0]) == 4
+    # top_k=1 at any temperature == argmax
+    for s in range(5):
+        t = sample_logits(logits, jax.random.key(s), temperature=2.0,
+                          top_k=1)
+        assert int(t[0]) == 4
+    # tiny top_p keeps only the argmax
+    for s in range(5):
+        t = sample_logits(logits, jax.random.key(s), temperature=2.0,
+                          top_p=1e-6)
+        assert int(t[0]) == 4
+    # top_k=2: only ids {3, 4} may appear over many draws, and both do
+    draws = {
+        int(sample_logits(logits, jax.random.key(s), temperature=5.0,
+                          top_k=2)[0])
+        for s in range(64)
+    }
+    assert draws == {3, 4}, draws
+    # top_p just over the top token's mass admits exactly the top two
+    p_top = float(jax.nn.softmax(logits)[0, 4])
+    draws_p = {
+        int(sample_logits(logits, jax.random.key(s), temperature=1.0,
+                          top_p=p_top + 1e-4)[0])
+        for s in range(64)
+    }
+    assert draws_p == {3, 4}, draws_p
+
+
+def test_generate_with_sampling_runs():
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    toks = jax.jit(
+        lambda p, t: llama.generate(
+            p, t, cfg, max_new_tokens=3, temperature=0.8, top_k=50,
+            top_p=0.9, key=jax.random.key(7),
+        )
+    )(params, prompt)
+    t = np.asarray(toks)
+    assert t.shape == (2, 3)
+    assert ((t >= 0) & (t < cfg.vocab_size)).all(), t
