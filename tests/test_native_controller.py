@@ -376,3 +376,66 @@ def test_eager_engine_native_process_sets_do_not_cross_fuse(
     np.testing.assert_allclose(oa[4], np.full((8,), 4.0))   # pass-through
     np.testing.assert_allclose(ob[2], np.full((8,), 25.0))
     np.testing.assert_allclose(ob[0], np.full((8,), 0.0))   # pass-through
+
+
+def test_hostile_frame_length_fails_transport_not_memory():
+    """A corrupt/hostile u32 length prefix on the control socket must fail
+    rank 0's tick with a transport error — NOT attempt a ~4 GiB
+    allocation (transport.cc kMaxFrameBytes bound)."""
+    import socket
+    import struct
+
+    spec_port = 19874
+    spec = f"tcp:127.0.0.1:{spec_port}"
+    outcome = {}
+    hello_sent = threading.Event()
+
+    def attacker():
+        # Pose as rank 1: valid hello, then a frame claiming ~2 GiB.
+        deadline = 30
+        s = None
+        for _ in range(300):
+            try:
+                s = socket.create_connection(("127.0.0.1", spec_port),
+                                             timeout=deadline)
+                break
+            except OSError:
+                import time as _t
+
+                _t.sleep(0.1)
+        assert s is not None, "could not reach coordinator"
+        s.sendall(struct.pack("<I", 1))               # hello: rank 1
+        hello_sent.set()
+        s.sendall(struct.pack("<I", 0x7FFFFFF0))      # hostile length
+        s.sendall(b"garbage")
+        import time as _t
+
+        _t.sleep(2)
+        s.close()
+
+    def rank0():
+        ctrl = native.NativeController(
+            rank=0, size=2, transport_spec=spec,
+            fusion_threshold_bytes=1 << 20,
+        )
+        try:
+            assert hello_sent.wait(30)
+            ctrl.submit(AR, "float32", "hostile.x", (4,))
+            try:
+                bl = ctrl.tick()
+                outcome["result"] = ("tick", bl.shutdown, len(bl.batches))
+            except RuntimeError as e:
+                outcome["result"] = ("raised", str(e))
+        finally:
+            ctrl.close()
+
+    threads = [threading.Thread(target=attacker),
+               threading.Thread(target=rank0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "hostile-frame test hung"
+    assert outcome["result"][0] == "raised", (
+        f"expected transport error on hostile frame, got {outcome['result']}"
+    )
