@@ -61,6 +61,12 @@ def main(argv: list[str] | None = None) -> int:
                         "nnodes > 1)")
     p.add_argument("--cpu", action="store_true",
                    help="pin workers to the CPU backend (simulation)")
+    p.add_argument("--restarts", type=int, default=0,
+                   help="relaunch the whole gang up to N times after a "
+                        "failure (fault tolerance without in-job world "
+                        "resize: workers resume via latest_checkpoint() + "
+                        "restore_checkpoint() at startup — see "
+                        "docs/running.md, 'The launcher')")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- command to run (e.g. -- python train.py)")
     args = p.parse_args(argv)
@@ -80,10 +86,45 @@ def main(argv: list[str] | None = None) -> int:
             "per host)"
         )
 
-    world = args.nnodes * args.nproc
-    coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
-    transport = args.controller_transport or f"tcp:127.0.0.1:{_free_port()}"
+    if args.restarts < 0:
+        p.error(f"--restarts must be >= 0, got {args.restarts}")
+    if args.restarts and args.nnodes > 1:
+        p.error(
+            "--restarts only coordinates a single-host gang; multi-host "
+            "restart needs an external supervisor on every node"
+        )
+    if args.restarts and (args.coordinator or args.controller_transport):
+        print(
+            "horovod_tpu.launch: warning: --restarts with explicit "
+            "--coordinator/--controller-transport rebinds the SAME ports "
+            "every attempt; a relaunch can fail to bind while the dead "
+            "gang's connections sit in TIME_WAIT.  Prefer auto ports "
+            "(omit the flags) for restartable single-host gangs.",
+            file=sys.stderr,
+        )
 
+    world = args.nnodes * args.nproc
+    for attempt in range(args.restarts + 1):
+        # Fresh auto ports per attempt: the dead gang's coordinator/
+        # controller listeners may linger in TIME_WAIT.
+        coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
+        transport = (
+            args.controller_transport or f"tcp:127.0.0.1:{_free_port()}"
+        )
+        rc = _run_gang(args, cmd, world, coordinator, transport)
+        if rc == 0 or rc == 130 or attempt == args.restarts:
+            return rc
+        print(
+            f"horovod_tpu.launch: gang failed (rc={rc}); restarting "
+            f"({attempt + 1}/{args.restarts}) — workers resume from their "
+            "latest checkpoint",
+            file=sys.stderr,
+        )
+    raise AssertionError("unreachable: the loop returns on its last pass")
+
+
+def _run_gang(args, cmd, world: int, coordinator: str,
+              transport: str) -> int:
     procs: list[subprocess.Popen] = []
     streams: list[threading.Thread] = []
     for i in range(args.nproc):

@@ -218,3 +218,51 @@ def test_launcher_rejects_nproc_zero():
         cwd=os.path.dirname(HERE),
     )
     assert r.returncode == 2 and "--nproc" in r.stderr
+
+
+def test_launcher_restarts_gang_until_success(tmp_path):
+    """--restarts N: a gang that fails once and succeeds on relaunch ends
+    with rc 0 (the resume-from-checkpoint fault-tolerance recipe);
+    with --restarts 0 the same failure is final."""
+    flaky = tmp_path / "flaky_worker.py"
+    marker = tmp_path / "attempted"
+    flaky.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "if not os.path.exists(marker):\n"
+        "    if os.environ['HOROVOD_TPU_PROCESS_ID'] == '0':\n"
+        "        open(marker, 'w').close()\n"
+        "    sys.exit(5)\n"
+        "print('recovered')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--restarts", "2", "--", sys.executable, str(flaky)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "restarting (1/2)" in r.stderr, r.stderr
+    assert "recovered" in r.stdout
+
+    marker.unlink()
+    r0 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable, str(flaky)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r0.returncode == 5, (r0.returncode, r0.stderr)
+
+
+def test_launcher_restarts_rejected_multihost():
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "1",
+         "--nnodes", "2", "--node-rank", "0", "--restarts", "1",
+         "--coordinator", "h:1", "--controller-transport", "tcp:h:2",
+         "--", "true"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 2
+    assert "external supervisor" in r.stderr
