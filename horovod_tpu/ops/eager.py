@@ -66,6 +66,7 @@ class _PendingOp:
     topk: TopKCompressor | None = None
     group_id: int | None = None            # caller-delimited fusion group
     process_set: Any = None                # ProcessSet restricting the op
+    no_fuse: bool = False                  # never share a fusion bucket
     enqueued_at: float = 0.0
 
 
@@ -254,8 +255,10 @@ class EagerEngine:
         """
         if p.kind != "allreduce":
             return ("solo", p.handle)
-        if p.op is collective_ops.Adasum:
-            # Per-tensor inner products: never share a fused buffer.
+        if p.op is collective_ops.Adasum or p.no_fuse:
+            # Adasum's inner products are per-tensor; no_fuse callers
+            # (e.g. int8 error feedback, whose residual must reproduce the
+            # wire's exact block quantization) opt out explicitly.
             return ("solo", p.handle)
         ps = p.process_set.ranks if p.process_set is not None else None
         base = ("ar", p.op.name, p.compression, str(p.tensor.dtype), ps)
@@ -339,6 +342,11 @@ class EagerEngine:
         ).__name__
         ps = p.process_set.ranks if p.process_set is not None else ()
         token = f"{p.op.name}:{comp}:{ps}".encode()
+        if p.no_fuse:
+            # Only the same-named request from the other ranks may join
+            # this batch — names are identical across ranks, so the batch
+            # stays exactly one tensor everywhere.
+            token += b":" + p.name.encode()
         import hashlib
 
         return int.from_bytes(hashlib.sha1(token).digest()[:7], "big")
@@ -704,11 +712,14 @@ def allreduce_async(
     compression=Compression.none,
     group_id: int | None = None,
     process_set=None,
+    no_fuse: bool = False,
 ) -> int:
     """Async all-reduce of a rank-major tensor; returns a handle
     (reference horovod/torch/mpi_ops.py:156-176).  ``process_set``
     restricts the reduction to member ranks; non-member rows pass through
-    unchanged (Horovod ≥0.22 API)."""
+    unchanged (Horovod ≥0.22 API).  ``no_fuse=True`` keeps this op out of
+    every fusion bucket (for callers whose local math must reproduce the
+    wire's per-tensor form exactly, e.g. int8 error feedback)."""
     if average is not None:
         op = Average if average else Sum
     eng = _engine()
@@ -725,6 +736,7 @@ def allreduce_async(
             compression=compression,
             group_id=group_id,
             process_set=process_set,
+            no_fuse=no_fuse,
         )
     )
     return h

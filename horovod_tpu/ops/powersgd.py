@@ -74,8 +74,25 @@ class ErrorFeedback:
             lambda g: jnp.zeros(g.shape, jnp.float32), grads_template
         )
 
+    def transmitted(self, corrected: jax.Array) -> jax.Array:
+        """What ONE rank's wire contribution to THIS tensor looks like
+        after the lossy compressor — the single definition of the residual
+        base that both the compiled path (``reduce``) and the eager hook
+        path (EagerDistributedOptimizer) share, so the two can never
+        desynchronize."""
+        if isinstance(self.inner, TopKCompressor):
+            flat = corrected.reshape(-1)
+            k = self.inner._k_for(flat.shape[0])
+            _, idxs = lax.top_k(jnp.abs(flat), k)
+            return (
+                jnp.zeros_like(flat).at[idxs].set(flat[idxs])
+                .reshape(corrected.shape)
+            )
+        return type(self.inner).roundtrip(corrected)
+
     def _reduce_leaf(self, g, e, axis_name, average):
         corrected = g.astype(jnp.float32) + e
+        residual = corrected - self.transmitted(corrected)
         if isinstance(self.inner, TopKCompressor):
             flat = corrected.reshape(-1)
             k = self.inner._k_for(flat.shape[0])
@@ -86,17 +103,13 @@ class ErrorFeedback:
             dense = jnp.zeros_like(flat).at[all_idxs].add(all_vals)
             if average:
                 dense = dense / _axis_size(axis_name)
-            transmitted = jnp.zeros_like(flat).at[idxs].set(picked)
-            residual = (flat - transmitted).reshape(corrected.shape)
             return dense.reshape(corrected.shape).astype(g.dtype), residual
         # int8: residual is this rank's own quantization error, computed by
         # the wire's own quantizer so the two can never drift.
-        cls = type(self.inner)
-        reduced = cls.quantized_allreduce(
+        reduced = type(self.inner).quantized_allreduce(
             corrected, average=average, axis_name=axis_name
         )
-        transmitted = cls.roundtrip(corrected)
-        return reduced.astype(g.dtype), corrected - transmitted
+        return reduced.astype(g.dtype), residual
 
     def reduce(self, grads, state, *, axis_name=AXIS_NAME, average=True):
         flat_g, treedef = jax.tree.flatten(grads)

@@ -31,7 +31,11 @@ import optax
 
 from horovod_tpu import basics
 from horovod_tpu.ops import eager as eager_ops
-from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.compression import (
+    Compression,
+    Int8Compressor,
+    TopKCompressor,
+)
 
 
 def _path_name(path) -> str:
@@ -70,6 +74,7 @@ class EagerDistributedOptimizer:
         diverge — use the compiled ``DistributedOptimizer(process_set=...)``
         inside shard_map with rank-major params for that."""
         from horovod_tpu.ops.collective_ops import Adasum, Average, Sum
+        from horovod_tpu.ops.powersgd import ErrorFeedback
 
         op = Average if op is None else op
         if op not in (Sum, Average, Adasum):
@@ -78,6 +83,22 @@ class EagerDistributedOptimizer:
             )
         if op is Adasum and is_sparse:
             raise ValueError("Adasum does not compose with the sparse path")
+        # Error feedback on the hook path: the optimizer OBJECT holds the
+        # per-parameter residuals (the define-by-run analogue of the state
+        # the compiled DistributedOptimizer threads through opt_state).
+        self.error_feedback: ErrorFeedback | None = None
+        if isinstance(compression, ErrorFeedback):
+            self.error_feedback = compression
+            compression = Compression.none   # the EF path picks the wire
+            if is_sparse or local:
+                raise ValueError(
+                    "ErrorFeedback compression already defines the wire; "
+                    "drop is_sparse/local"
+                )
+            if op is Adasum:
+                raise ValueError(
+                    "Adasum does not compose with ErrorFeedback compression"
+                )
         if op is Adasum and callable(
             getattr(compression, "quantized_allreduce", None)
         ):
@@ -100,6 +121,8 @@ class EagerDistributedOptimizer:
         self._passes = 0
         self._loss_handle: int | None = None
         self._grad_fn_cache: dict[int, Callable] = {}
+        self._residuals: dict[str, jax.Array] = {}
+        self._handle_dtypes: dict[int, Any] = {}
 
     def init(self, params: Any):
         return self.tx.init(params)
@@ -145,7 +168,9 @@ class EagerDistributedOptimizer:
         if not self.local:
             for path, g in flat:
                 name = "grad." + _path_name(path)
-                if self.is_sparse:
+                if self.error_feedback is not None:
+                    h = self._enqueue_with_error_feedback(name, g)
+                elif self.is_sparse:
                     h = eager_ops.sparse_allreduce_async(
                         g, name=name, average=True, ratio=self.sparse_ratio
                     )
@@ -164,6 +189,42 @@ class EagerDistributedOptimizer:
         )
         return jnp.mean(losses)
 
+    def _enqueue_with_error_feedback(self, name: str, g: jax.Array) -> int:
+        """Residual-corrected lossy allreduce on the hook path.
+
+        ``g`` is rank-major [size, ...]; the residual is rank-major too
+        (each rank's own compression error), keyed by the stable gradient
+        name.  The wire is the inner compressor's collective (top-k
+        allgather / int8 all-gather); the local ``transmitted`` copy is
+        ``ErrorFeedback.transmitted`` — the SAME definition the compiled
+        path uses — and int8 ops enqueue with ``no_fuse=True`` so the
+        wire quantizes THIS tensor alone (a fused buffer's block scales
+        would differ from the per-tensor roundtrip and bias the residual).
+        """
+        inner = self.error_feedback.inner
+        res = self._residuals.get(name)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, jnp.float32)
+        corrected = g.astype(jnp.float32) + res
+        from horovod_tpu.ops.collective_ops import Average
+
+        transmitted = jax.vmap(self.error_feedback.transmitted)(corrected)
+        if isinstance(inner, TopKCompressor):
+            h = eager_ops.sparse_allreduce_async(
+                corrected, name=name, average=self.op is Average,
+                ratio=inner.ratio, k=inner.k,
+            )
+        else:                                 # Int8Compressor
+            h = eager_ops.allreduce_async(
+                corrected, name=name, op=self.op,
+                compression=Compression.int8, no_fuse=True,
+            )
+        self._residuals[name] = corrected - transmitted
+        # The wire moved fp32; restore the caller's grad dtype on drain so
+        # opt_state dtypes match init (the compiled path's .astype(g.dtype)).
+        self._handle_dtypes[h] = g.dtype
+        return h
+
     # ----------------------------------------------------------------- step
 
     def synchronize(self) -> Any:
@@ -176,7 +237,13 @@ class EagerDistributedOptimizer:
         if self.local:
             leaves = self._local_grads
         else:
-            leaves = [eager_ops.synchronize(h) for _, h in self._handles]
+            leaves = []
+            for _, h in self._handles:
+                out = eager_ops.synchronize(h)
+                want = self._handle_dtypes.pop(h, None)
+                if want is not None and out.dtype != want:
+                    out = out.astype(want)
+                leaves.append(out)
         self._handles = []
         return jax.tree.unflatten(self._treedef, leaves)
 
