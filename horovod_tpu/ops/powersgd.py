@@ -40,8 +40,10 @@ from horovod_tpu.ops.compression import Int8Compressor, TopKCompressor
 class ErrorFeedback:
     """Residual-corrected lossy all-reduce (EF-SGD / EF14).
 
-    Wraps a lossy compressor ``inner`` ∈ {:class:`TopKCompressor`,
-    :class:`Int8Compressor`} and keeps one residual per gradient leaf:
+    Wraps a lossy compressor ``inner`` — :class:`TopKCompressor` or any
+    quantized-wire compressor exposing ``quantized_allreduce`` +
+    ``roundtrip`` (:class:`Int8Compressor`, :class:`Int4Compressor`) —
+    and keeps one residual per gradient leaf:
 
         corrected = grad + residual
         reduced   = lossy_allreduce(corrected)
@@ -55,15 +57,16 @@ class ErrorFeedback:
     """
 
     def __init__(self, inner):
-        if not isinstance(inner, (TopKCompressor, Int8Compressor)) and not (
-            isinstance(inner, type)
-            and issubclass(inner, (TopKCompressor, Int8Compressor))
-        ):
+        cls = inner if isinstance(inner, type) else type(inner)
+        quantized = callable(getattr(cls, "quantized_allreduce", None)) and (
+            callable(getattr(cls, "roundtrip", None))
+        )
+        if not (issubclass(cls, TopKCompressor) or quantized):
             raise TypeError(
                 "ErrorFeedback supports the lossy wire compressors "
-                f"(topk / int8); got {inner!r}. Dense cast compressors "
-                "(fp16/bf16) lose nothing an allreduce can recover — use "
-                "them directly."
+                f"(topk / int8 / int4); got {inner!r}. Dense cast "
+                "compressors (fp16/bf16) lose nothing an allreduce can "
+                "recover — use them directly."
             )
         if isinstance(inner, type):
             inner = inner()

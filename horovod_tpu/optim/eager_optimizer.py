@@ -31,11 +31,7 @@ import optax
 
 from horovod_tpu import basics
 from horovod_tpu.ops import eager as eager_ops
-from horovod_tpu.ops.compression import (
-    Compression,
-    Int8Compressor,
-    TopKCompressor,
-)
+from horovod_tpu.ops.compression import Compression, TopKCompressor
 
 
 def _path_name(path) -> str:
@@ -214,10 +210,12 @@ class EagerDistributedOptimizer:
                 corrected, name=name, average=self.op is Average,
                 ratio=inner.ratio, k=inner.k,
             )
-        else:                                 # Int8Compressor
+        else:                                 # quantized wire (int8/int4)
+            # ErrorFeedback.__init__ normalizes inner to an instance.
+            cls = type(inner)
             h = eager_ops.allreduce_async(
                 corrected, name=name, op=self.op,
-                compression=Compression.int8, no_fuse=True,
+                compression=cls, no_fuse=True,
             )
         self._residuals[name] = corrected - transmitted
         # The wire moved fp32; restore the caller's grad dtype on drain so

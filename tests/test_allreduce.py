@@ -105,8 +105,10 @@ def test_allreduce_int8_average_and_exact_levels():
 
 
 def test_allreduce_int8_dense_path_raises():
-    with pytest.raises(NotImplementedError, match="changes the collective"):
+    with pytest.raises(NotImplementedError, match="change the collective"):
         hvd.Compression.int8.compress(jnp.ones((4,)))
+    with pytest.raises(NotImplementedError, match="change the collective"):
+        hvd.Compression.int4.compress(jnp.ones((4,)))
 
 
 def test_int8_fused_bucket_preserves_small_tensors():
