@@ -38,6 +38,13 @@ class MoEConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # Router z-loss (ST-MoE): penalizes large router logit norms —
+    # log²(Σe^logit) per token — which keeps the softmax out of its
+    # saturated region and stabilizes bf16 training.  0 disables.
+    z_loss_weight: float = 0.0
+    # Multiplicative jitter on router inputs during training (Switch
+    # Transformer's input noise): x · U[1−ε, 1+ε].  0 disables.
+    router_jitter: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -115,15 +122,47 @@ def route(cfg: MoEConfig, logits: jax.Array) -> tuple[jax.Array, jax.Array, jax.
     return dispatch.astype(jnp.float32), combine.astype(jnp.float32), aux
 
 
-def forward(params: dict, x: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
-    """MoE MLP: x [T, D] → (y [T, D], aux_loss).
+def router_logits(
+    params: dict, x: jax.Array, cfg: MoEConfig,
+    *, noise_key: jax.Array | None = None,
+) -> jax.Array:
+    """Router scores [T, E], with optional training-time jitter: the Switch
+    Transformer's multiplicative input noise ``x · U[1−ε, 1+ε]``
+    (``cfg.router_jitter``), applied only when a ``noise_key`` is given."""
+    xf = x.astype(jnp.float32)
+    if noise_key is not None and cfg.router_jitter > 0.0:
+        eps = cfg.router_jitter
+        xf = xf * jax.random.uniform(
+            noise_key, xf.shape, jnp.float32, 1.0 - eps, 1.0 + eps
+        )
+    return xf @ params["router"].astype(jnp.float32)
+
+
+def weighted_aux(cfg: MoEConfig, aux: jax.Array,
+                 logits: jax.Array) -> jax.Array:
+    """Combine the Switch balance loss with the ST-MoE router z-loss —
+    ``mean(log²Σ_e e^logit)``, which keeps router logits small and the
+    softmax out of its saturated region (bf16 stability)."""
+    total = cfg.aux_loss_weight * aux
+    if cfg.z_loss_weight:
+        z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        total = total + cfg.z_loss_weight * jnp.mean(z ** 2)
+    return total
+
+
+def forward(
+    params: dict, x: jax.Array, cfg: MoEConfig,
+    *, noise_key: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """MoE MLP: x [T, D] → (y [T, D], aux_loss).  ``noise_key`` enables
+    the training-time router jitter (see :func:`router_logits`).
 
     The GSPMD path: with ``w_in``/``w_out`` sharded over ``ep`` and the
     einsums below, XLA inserts the token all-to-alls — same comm pattern a
     hand-written EP implementation issues, derived from the sharding.
     """
     dt = cfg.dtype
-    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    logits = router_logits(params, x, cfg, noise_key=noise_key)
     dispatch, combine, aux = route(cfg, logits)
     # Tokens → expert buffers: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x.astype(dt))
@@ -132,11 +171,12 @@ def forward(params: dict, x: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.
     )
     expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(dt))
     y = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
-    return y.astype(x.dtype), cfg.aux_loss_weight * aux
+    return y.astype(x.dtype), weighted_aux(cfg, aux, logits)
 
 
 def expert_parallel_mlp(
-    params: dict, x: jax.Array, cfg: MoEConfig, *, axis_name: str = "ep"
+    params: dict, x: jax.Array, cfg: MoEConfig, *, axis_name: str = "ep",
+    noise_key: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Explicit shard_map form: each device holds E/n experts and its own
     token shard; tokens move via ``lax.all_to_all`` (the MoE dispatch
@@ -151,7 +191,7 @@ def expert_parallel_mlp(
     dt = cfg.dtype
     full_cfg = dataclasses.replace(cfg, n_experts=e_loc * n)
 
-    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    logits = router_logits(params, x, cfg, noise_key=noise_key)
     dispatch, combine, aux = route(full_cfg, logits)
 
     # Local dispatch to ALL experts' buffers, then all-to-all exchanges
@@ -172,5 +212,5 @@ def expert_parallel_mlp(
     out = lax.all_to_all(out, axis_name, 1, 0, tiled=True)
     y = jnp.einsum("tec,ecd->td", combine.astype(dt), out)
     # aux is computed from the local token shard; mean over devices.
-    aux = lax.pmean(aux, axis_name)
-    return y.astype(x.dtype), cfg.aux_loss_weight * aux
+    total = lax.pmean(weighted_aux(full_cfg, aux, logits), axis_name)
+    return y.astype(x.dtype), total

@@ -257,3 +257,31 @@ class TestPipeline:
             np.testing.assert_allclose(
                 np.asarray(g0[k]), np.asarray(g1[k]), atol=1e-6
             )
+
+
+def test_moe_z_loss_and_jitter():
+    """ST-MoE z-loss raises the aux term by mean(log²Σe^logit); router
+    jitter perturbs routing only when a noise key is provided."""
+    import dataclasses
+
+    cfg0 = moe.MoEConfig(dim=16, ffn_dim=32, n_experts=4,
+                         dtype=jnp.float32, z_loss_weight=0.0)
+    cfgz = dataclasses.replace(cfg0, z_loss_weight=1e-3)
+    params = moe.init_params(cfg0, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    y0, aux0 = moe.forward(params, x, cfg0)
+    yz, auxz = moe.forward(params, x, cfgz)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(yz))  # outputs equal
+    logits = moe.router_logits(params, x, cfg0)
+    z = np.asarray(jax.nn.logsumexp(np.asarray(logits), axis=-1))
+    np.testing.assert_allclose(
+        float(auxz - aux0), 1e-3 * float(np.mean(z ** 2)), rtol=1e-5
+    )
+
+    # Jitter: no key → deterministic and identical; key → routing changes.
+    cfgj = dataclasses.replace(cfg0, router_jitter=0.8)
+    ya, _ = moe.forward(params, x, cfgj)
+    yb, _ = moe.forward(params, x, cfgj)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb))
+    yn, _ = moe.forward(params, x, cfgj, noise_key=jax.random.key(2))
+    assert np.abs(np.asarray(yn) - np.asarray(ya)).max() > 0
