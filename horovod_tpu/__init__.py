@@ -103,6 +103,12 @@ from horovod_tpu.checkpoint import (  # noqa: F401
 )
 from horovod_tpu.optim.eager_optimizer import EagerDistributedOptimizer  # noqa: F401
 from horovod_tpu.optim.zero import ZeroStepResult, make_zero_train_step  # noqa: F401
+from horovod_tpu.optim.fsdp import (  # noqa: F401
+    FsdpStepResult,
+    fsdp_partition_specs,
+    make_fsdp_train_step,
+    shard_params,
+)
 from horovod_tpu.training import fit, make_eval_step  # noqa: F401
 from horovod_tpu.data import ShardedLoader, shard_indices  # noqa: F401
 from horovod_tpu.timeline import start_timeline, stop_timeline  # noqa: F401

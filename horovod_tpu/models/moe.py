@@ -191,6 +191,11 @@ def expert_parallel_mlp(
     dt = cfg.dtype
     full_cfg = dataclasses.replace(cfg, n_experts=e_loc * n)
 
+    if noise_key is not None:
+        # Per-shard decorrelation: inside shard_map every device sees the
+        # same replicated key and the same local shape, so without the
+        # fold-in each token shard would draw IDENTICAL jitter.
+        noise_key = jax.random.fold_in(noise_key, lax.axis_index(axis_name))
     logits = router_logits(params, x, cfg, noise_key=noise_key)
     dispatch, combine, aux = route(full_cfg, logits)
 

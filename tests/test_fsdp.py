@@ -1,0 +1,137 @@
+"""FSDP-style sharded-parameter training (optim/fsdp.py): spec derivation,
+sharded residency, and numerical equality with replicated DP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.optim.fsdp import (
+    FsdpStepResult,
+    fsdp_partition_specs,
+    make_fsdp_train_step,
+    shard_params,
+)
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "w1": jnp.asarray(rng.randn(16, 64).astype(np.float32)),
+        "w2": jnp.asarray(rng.randn(64, 8).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(8).astype(np.float32)),   # tiny: replicated
+    }
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.mean((h @ params["w2"] + params["b"] - y) ** 2)
+
+
+def test_fsdp_partition_specs_shard_largest_divisible_dim():
+    specs = fsdp_partition_specs(_params(), min_shard_elems=64)
+    assert specs["w1"] == P(None, "hvd")      # 64 divisible by 8
+    assert specs["w2"] == P("hvd", None)      # largest dim 64
+    assert specs["b"] == P()                  # too small
+    odd = {"w": jnp.zeros((10, 6))}           # 60 elems < 64 → replicated
+    assert fsdp_partition_specs(odd, min_shard_elems=64)["w"] == P()
+    indivisible = {"w": jnp.zeros((9, 13))}
+    assert fsdp_partition_specs(
+        indivisible, min_shard_elems=1
+    )["w"] == P()                             # no dim divisible by 8
+
+
+def test_fsdp_params_and_state_stay_sharded():
+    params = _params()
+    step, init = make_fsdp_train_step(_loss_fn, optax.adam(1e-2),
+                                      donate=False)
+    specs = fsdp_partition_specs(params)
+    sharded = shard_params(params, specs)
+    opt_state = init(sharded)
+    n = hvd.size()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(n * 4, 16).astype(np.float32))
+    y = jnp.asarray(rng.randn(n * 4, 8).astype(np.float32))
+    out = step(sharded, opt_state, (x, y))
+    assert isinstance(out, FsdpStepResult)
+    # Params remain sharded: each leaf's sharding spec survives the step.
+    got = out.params["w1"].sharding.spec
+    assert tuple(got) == (None, "hvd"), got
+    # Adam moments inherit the param's spec (state at 1/n per chip).
+    mu = jax.tree.leaves(out.opt_state)
+    shardings = {str(l.sharding.spec) for l in mu if l.ndim == 2}
+    assert any("hvd" in s for s in shardings), shardings
+
+
+def test_fsdp_matches_replicated_training():
+    """The sharded step computes the same math as replicated DP: identical
+    losses and identical final params (modulo reduction-order noise)."""
+    params = _params()
+    n = hvd.size()
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(n * 4, 16).astype(np.float32))
+    y = jnp.asarray(rng.randn(n * 4, 8).astype(np.float32))
+
+    # Replicated oracle: same batch, plain single-program training.
+    tx = optax.adam(1e-2)
+    rp = jax.tree.map(jnp.copy, params)
+    rs = tx.init(rp)
+
+    @jax.jit
+    def rep_step(p, s):
+        loss, g = jax.value_and_grad(_loss_fn)(p, (x, y))
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    step, init = make_fsdp_train_step(_loss_fn, optax.adam(1e-2),
+                                      donate=False)
+    specs = fsdp_partition_specs(params)
+    fp = shard_params(params, specs)
+    fs = init(fp)
+    for i in range(10):
+        rp, rs, rloss = rep_step(rp, rs)
+        out = step(fp, fs, (x, y))
+        fp, fs = out.params, out.opt_state
+        np.testing.assert_allclose(float(out.loss), float(rloss),
+                                   rtol=1e-5, atol=1e-6)
+    for k in ("w1", "w2", "b"):
+        np.testing.assert_allclose(
+            np.asarray(fp[k]), np.asarray(rp[k]), rtol=1e-4, atol=1e-5,
+            err_msg=k,
+        )
+
+
+def test_fsdp_memory_shards_are_actual_fractions():
+    """Each process's addressable shard of a sharded leaf holds 1/n of the
+    elements (the FSDP memory claim, verifiable on the virtual mesh)."""
+    params = {"w": jnp.zeros((64, 32), jnp.float32)}
+    sharded = shard_params(params, fsdp_partition_specs(params))
+    n = hvd.size()
+    shard = sharded["w"].addressable_shards[0].data
+    assert shard.size == (64 * 32) // n, shard.shape
+
+
+def test_fsdp_step_rekeys_on_new_model_shapes():
+    """One step function serving two differently-shaped models must
+    recompile with each model's own shardings, not apply the first's."""
+    step, init = make_fsdp_train_step(_loss_fn, optax.adam(1e-2),
+                                      donate=False)
+    n = hvd.size()
+    rng = np.random.RandomState(3)
+    for scale in (1, 2):
+        params = {
+            "w1": jnp.asarray(rng.randn(16, 64 * scale).astype(np.float32)),
+            "w2": jnp.asarray(rng.randn(64 * scale, 8).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(8).astype(np.float32)),
+        }
+        sharded = shard_params(params, fsdp_partition_specs(params))
+        st = init(sharded)
+        x = jnp.asarray(rng.randn(n * 2, 16).astype(np.float32))
+        y = jnp.asarray(rng.randn(n * 2, 8).astype(np.float32))
+        out = step(sharded, st, (x, y))
+        assert np.isfinite(float(out.loss))
+        assert out.params["w1"].shape == (16, 64 * scale)
