@@ -38,12 +38,23 @@ def main():
                             "ulysses_flash", "flash"])
     p.add_argument("--zero", action="store_true",
                    help="ZeRO sharded optimizer (state at 1/n per chip)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded params AND optimizer state "
+                        "(1/n per chip between steps; docs/api.md)")
+    p.add_argument("--fused-loss", action="store_true",
+                   help="chunked fused linear+cross-entropy (no [B*L, V] "
+                        "logits residency; docs/compression.md)")
     args = p.parse_args()
+    if args.zero and args.fsdp:
+        p.error("--zero and --fsdp are alternative sharding strategies")
 
     hvd.init()
     n = hvd.size()
     cfg = (llama.llama_tiny if args.tiny else llama.llama3_8b)(
-        attn_impl=args.attn
+        attn_impl=args.attn,
+        fused_loss_chunk=(
+            (64 if args.tiny else 8192) if args.fused_loss else None
+        ),
     )
     seq = args.seq_len or min(cfg.max_seq_len, 512 if args.tiny else 4096)
 
@@ -52,7 +63,15 @@ def main():
     loss_fn = llama.make_loss_fn(cfg)
 
     adamw = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
-    if args.zero:
+    if args.fsdp:
+        # Params + Adam moments sharded between steps; GSPMD gathers each
+        # layer just-in-time and reduce-scatters its gradients.
+        step, init_opt = hvd.make_fsdp_train_step(
+            loss_fn, optax.chain(optax.clip_by_global_norm(1.0), adamw)
+        )
+        params = hvd.shard_params(params, hvd.fsdp_partition_specs(params))
+        opt_state = init_opt(params)
+    elif args.zero:
         # Sharded optimizer: Adam moments at 1/n per chip; clipping uses
         # the true global norm computed from the gradient shards.
         step, init_opt = hvd.make_zero_train_step(

@@ -68,6 +68,14 @@ def test_llama_finetune_tiny_zero():
     )
 
 
+def test_llama_finetune_tiny_fsdp_fused_loss():
+    run_example(
+        "llama_finetune.py",
+        ["--tiny", "--steps", "2", "--seq-len", "64", "--fsdp",
+         "--fused-loss"],
+    )
+
+
 @pytest.mark.slow
 def test_resnet50_smoke(tmp_path):
     run_example(
