@@ -90,6 +90,7 @@ from horovod_tpu.callbacks import (  # noqa: F401
     LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
+    ModelCheckpointCallback,
     average_metrics,
     multiplier_schedule,
     warmup_schedule,

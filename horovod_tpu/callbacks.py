@@ -230,3 +230,31 @@ class LearningRateScheduleCallback(Callback):
             state = self.scale_momentum(state, lr / self._last_lr)
         self._last_lr = lr
         return state
+
+
+class ModelCheckpointCallback(Callback):
+    """Rank-0 periodic checkpointing from inside ``fit`` — the reference's
+    ``keras.callbacks.ModelCheckpoint`` slot in its canonical callback
+    stack (reference examples/keras_imagenet_resnet50.py:155-158: appended
+    on rank 0 only; here the rank gate lives in ``save_checkpoint``).
+
+    Writes ``<path>/step_<epoch>`` every ``every_epochs``; ``async_save``
+    uses the background orbax writer so the epoch loop never blocks on
+    disk.  Resume with ``latest_checkpoint`` + ``restore_checkpoint``.
+    """
+
+    def __init__(self, path: str, *, every_epochs: int = 1,
+                 async_save: bool = False):
+        if every_epochs < 1:
+            raise ValueError(f"every_epochs must be >= 1, got {every_epochs}")
+        self.path = path
+        self.every_epochs = every_epochs
+        self.async_save = async_save
+
+    def on_epoch_end(self, epoch, state, metrics):
+        if (epoch + 1) % self.every_epochs == 0:
+            from horovod_tpu.checkpoint import save_checkpoint
+
+            save_checkpoint(self.path, state, step=epoch,
+                            async_save=self.async_save)
+        return metrics

@@ -161,3 +161,49 @@ def test_async_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.arange(6.0).reshape(2, 3))
     assert int(np.asarray(restored["step"])) == 42
+
+
+def test_model_checkpoint_callback(tmp_path):
+    """ModelCheckpointCallback inside fit: step_<epoch> dirs appear on the
+    configured cadence and the latest one restores."""
+    import optax
+
+    from horovod_tpu.checkpoint import latest_checkpoint, restore_checkpoint
+    from horovod_tpu.data import ShardedLoader
+
+    n = hvd.size()
+    rng = np.random.RandomState(31)
+    x = rng.randn(n * 8, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 2)).astype(np.float32)
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch[0] @ params["w"] - batch[1]) ** 2)
+
+    params = {"w": jnp.zeros((4, 2), jnp.float32)}
+    ck = tmp_path / "fit_ckpts"
+    params, opt_state, history = hvd.fit(
+        params,
+        # momentum: a stateful optimizer, so the checkpoint carries real
+        # opt-state leaves (orbax rejects all-empty subtrees).
+        hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9)),
+        loss_fn,
+        ShardedLoader((x, y), 2),
+        epochs=4,
+        callbacks=[hvd.ModelCheckpointCallback(str(ck), every_epochs=2)],
+        verbose=False,
+    )
+    import os
+
+    written = sorted(os.listdir(ck))
+    assert written == ["step_1", "step_3"], written
+    latest = latest_checkpoint(str(ck))
+    assert latest.endswith("step_3")
+    # fit's callback state pytree is the (params, opt_state) tuple.
+    restored = restore_checkpoint(latest, (params, opt_state))
+    np.testing.assert_array_equal(
+        np.asarray(restored[0]["w"]), np.asarray(params["w"])
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="every_epochs"):
+        hvd.ModelCheckpointCallback(str(ck), every_epochs=0)
