@@ -98,3 +98,11 @@ def test_synthetic_benchmark_compression_smoke():
         "synthetic_benchmark.py",
         ["--smoke", "--batch-size", "2", "--adasum"],
     )
+
+
+def test_llama_generate_example():
+    run_example(
+        "llama_generate.py",
+        ["--tiny", "--max-new-tokens", "6", "--temperature", "0.8",
+         "--top-k", "40", "--top-p", "0.9"],
+    )
