@@ -19,7 +19,7 @@ from __future__ import annotations
 import math
 import queue
 import threading
-from typing import Any, Callable, Iterator, Sequence
+from typing import Any, Iterator
 
 import jax
 import numpy as np

@@ -34,7 +34,7 @@ from jax import lax
 
 from horovod_tpu.basics import AXIS_NAME
 from horovod_tpu.ops.collective_ops import _axis_size
-from horovod_tpu.ops.compression import Int8Compressor, TopKCompressor
+from horovod_tpu.ops.compression import TopKCompressor
 
 
 class ErrorFeedback:
