@@ -106,3 +106,11 @@ def test_llama_generate_example():
         ["--tiny", "--max-new-tokens", "6", "--temperature", "0.8",
          "--top-k", "40", "--top-p", "0.9"],
     )
+
+
+@pytest.mark.slow
+def test_scaling_benchmark_smoke():
+    run_example(
+        "scaling_benchmark.py",
+        ["--model", "mlp", "--bs", "2", "--iters", "1", "--batches", "1"],
+    )
