@@ -1,0 +1,328 @@
+"""``import horovod_tpu.torch as hvd`` — the reference's PyTorch frontend.
+
+The reference is torch-first (reference horovod/torch/__init__.py,
+mpi_ops.py); its users hold ONE CPU/GPU tensor per process under
+``mpirun``.  This adapter reproduces that surface on the TPU-native
+engine: each process's torch tensor becomes this process's row of a
+rank-major jax array (``jax.make_array_from_process_local_data``), the
+eager engine negotiates over the native TCP control plane and dispatches
+the XLA collective, and the result lands back in a torch tensor.
+
+Topology: ONE device per process — exactly the reference's process model
+(one rank per accelerator).  ``init()`` raises in single-controller
+multi-device worlds, where the JAX-native API (rank-major arrays) is the
+right surface instead.
+
+Parity surface (reference horovod/torch/__init__.py):
+``init/shutdown/rank/local_rank/size/local_size``, blocking + async +
+in-place allreduce/allgather/broadcast, ``poll``/``synchronize``,
+``broadcast_parameters``, ``broadcast_optimizer_state``,
+``DistributedOptimizer`` (post-accumulate-grad hooks fire async
+allreduces during backward; ``step()`` drains), and ``Compression``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu import basics as _basics
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops.collective_ops import Adasum, Average, Sum  # noqa: F401
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def init(*args, **kwargs) -> None:
+    _hvd.init(*args, **kwargs)
+    import jax
+
+    if jax.local_device_count() != 1 and _basics.size() != 1:
+        # Tear the world back down BEFORE raising: the message tells the
+        # user to call the JAX-native init() instead, and that call would
+        # silently no-op against an already-initialized all-devices world.
+        _hvd.shutdown()
+        raise RuntimeError(
+            "horovod_tpu.torch expects the reference's process model: ONE "
+            f"device per process (got {jax.local_device_count()} local "
+            "devices).  Launch one process per chip (python -m "
+            "horovod_tpu.launch / one process per host with 1 visible "
+            "device), or use the JAX-native horovod_tpu API for "
+            "single-controller multi-device worlds."
+        )
+
+
+shutdown = _hvd.shutdown
+rank = _hvd.rank
+local_rank = _hvd.local_rank
+size = _hvd.size
+local_size = _hvd.local_size
+mpi_threads_supported = _hvd.mpi_threads_supported
+is_initialized = _hvd.is_initialized
+
+
+def _to_rank_major(t) -> Any:
+    """This process's torch tensor → its row of the rank-major array."""
+    import jax
+
+    local = np.ascontiguousarray(t.detach().cpu().numpy())
+    if _basics.size() == 1:
+        return jax.device_put(local[None], _basics.rank_sharding())
+    return jax.make_array_from_process_local_data(
+        _basics.rank_sharding(), local[None]
+    )
+
+
+def _to_torch(arr) -> Any:
+    import jax
+
+    torch = _torch()
+    return torch.from_numpy(np.array(jax.device_get(arr)))
+
+
+# ---------------------------------------------------------------------- ops
+
+
+def allreduce_async(tensor, average=True, name=None, *, op=None,
+                    compression=Compression.none) -> int:
+    if op is None:
+        op = Average if average else Sum
+    return _eager.allreduce_async(
+        _to_rank_major(tensor), name=name, op=op, compression=compression
+    )
+
+
+def allreduce(tensor, average=True, name=None, *, op=None,
+              compression=Compression.none):
+    return synchronize(
+        allreduce_async(tensor, average, name, op=op, compression=compression)
+    )
+
+
+def allreduce_(tensor, average=True, name=None, *, op=None,
+               compression=Compression.none):
+    """In-place variant (reference allreduce_): result copied back."""
+    out = allreduce(tensor, average, name, op=op, compression=compression)
+    tensor.copy_(out)
+    return tensor
+
+
+def allgather_async(tensor, name=None) -> int:
+    return _eager.allgather_async(_to_rank_major(tensor), name=name)
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    return _eager.broadcast_async(_to_rank_major(tensor), root_rank,
+                                  name=name)
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank, name=None):
+    out = broadcast(tensor, root_rank, name)
+    tensor.copy_(out)
+    return tensor
+
+
+def poll(handle: int) -> bool:
+    return _eager.poll(handle)
+
+
+def synchronize(handle: int):
+    return _to_torch(_eager.synchronize(handle))
+
+
+# ------------------------------------------------------------- state sync
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place sync of a ``state_dict()`` or ``named_parameters()``
+    iterable from ``root_rank`` (reference torch/__init__.py:270-299)."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = [
+        (t, broadcast_async(t.data, root_rank, name=f"bp.{name}"))
+        for name, t in items
+    ]
+    for t, h in handles:
+        t.data.copy_(synchronize(h))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Sync a torch optimizer's state from ``root_rank``.
+
+    The reference needs ~100 lines of scalar→tensor wrapping
+    (torch/__init__.py:302-418); here the ROOT's state_dict shape is
+    authoritative: its skeleton (with per-tensor shape/dtype) rides one
+    pickled ``broadcast_object``, then every rank — including workers
+    whose local optimizer has no state yet, e.g. fresh processes syncing
+    from a restored root — posts exactly the root's tensor count of
+    broadcasts, contributing placeholder zeros where it has nothing."""
+    torch = _torch()
+    sd = optimizer.state_dict()
+    tensors: list = []
+
+    def strip(obj):
+        if isinstance(obj, torch.Tensor):
+            tensors.append(obj)
+            return ("__hvd_tensor__", len(tensors) - 1, tuple(obj.shape),
+                    str(obj.dtype).removeprefix("torch."))
+        if isinstance(obj, dict):
+            return {k: strip(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [strip(v) for v in obj]
+            return out if isinstance(obj, list) else tuple(out)
+        return obj
+
+    skeleton = _hvd.broadcast_object(strip(sd), root_rank)
+
+    def placeholders(obj, out):
+        if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__hvd_tensor__":
+            out.append((obj[1], obj[2], obj[3]))
+        elif isinstance(obj, dict):
+            for v in obj.values():
+                placeholders(v, out)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                placeholders(v, out)
+
+    slots: list = []
+    placeholders(skeleton, slots)
+    slots.sort()
+    handles = []
+    for idx, shape, dtype_name in slots:
+        local = (
+            tensors[idx] if idx < len(tensors) and root_rank == rank()
+            else torch.zeros(shape, dtype=getattr(torch, dtype_name))
+        )
+        handles.append(broadcast_async(local, root_rank, name=f"bos.{idx}"))
+    synced = [synchronize(h) for h in handles]
+
+    def rebuild(obj):
+        if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__hvd_tensor__":
+            return synced[obj[1]]
+        if isinstance(obj, dict):
+            return {k: rebuild(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [rebuild(v) for v in obj]
+        if isinstance(obj, tuple):
+            return tuple(rebuild(v) for v in obj)
+        return obj
+
+    optimizer.load_state_dict(rebuild(skeleton))
+
+
+def broadcast_object(obj, root_rank: int = 0):
+    return _hvd.broadcast_object(obj, root_rank)
+
+
+# --------------------------------------------------------------- optimizer
+
+
+class _DistributedOptimizer:
+    """Hook-based wrapper (reference torch/__init__.py:86-267): each
+    parameter's post-accumulate-grad hook fires an async allreduce as the
+    gradient is produced; ``step()`` drains every handle, installs the
+    reduced gradients, and runs the base optimizer."""
+
+    def __init__(self, optimizer, named_parameters=None, *,
+                 compression=Compression.none, op=None,
+                 backward_passes_per_step: int = 1):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op if op is not None else Average
+        self._bpps = backward_passes_per_step
+        if named_parameters is None:
+            named_parameters = [
+                (f"param.{gi}.{pi}", p)
+                for gi, group in enumerate(optimizer.param_groups)
+                for pi, p in enumerate(group["params"])
+            ]
+        else:
+            named_parameters = list(named_parameters)
+        self._named = named_parameters
+        self._handles: dict = {}
+        self._passes: dict = {}
+        self._hooks = []
+        for name, p in self._named:
+            if p.requires_grad:
+                self._hooks.append(p.register_post_accumulate_grad_hook(
+                    self._make_hook(name)
+                ))
+
+    def _make_hook(self, name):
+        def hook(p):
+            n = self._passes.get(name, 0) + 1
+            self._passes[name] = n
+            if n % self._bpps != 0:
+                return      # keep accumulating locally (reference :115)
+            self._handles[name] = (p, allreduce_async(
+                p.grad, name=f"grad.{name}", op=self._op,
+                compression=self._compression,
+            ))
+        return hook
+
+    def synchronize(self) -> None:
+        torch = _torch()
+        # Force-allreduce parameters whose hooks never fired this step
+        # (frozen/conditional branches): ranks can DISAGREE on which grads
+        # materialized, and a rank that skips the collective would deadlock
+        # the ranks that posted it — the reference enqueues missing params
+        # in synchronize() for exactly this reason (torch/__init__.py:
+        # 190-197; its test_force_allreduce pins the two-headed-net case).
+        for name, p in self._named:
+            if not p.requires_grad or name in self._handles:
+                continue
+            if p.grad is None:
+                p.grad = torch.zeros_like(p)
+            self._handles[name] = (p, allreduce_async(
+                p.grad, name=f"grad.{name}", op=self._op,
+                compression=self._compression,
+            ))
+        for name, (p, h) in list(self._handles.items()):
+            p.grad.copy_(synchronize(h))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **k):
+        return self._opt.zero_grad(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None, *,
+                         compression=Compression.none, op=None,
+                         backward_passes_per_step: int = 1):
+    return _DistributedOptimizer(
+        optimizer, named_parameters, compression=compression, op=op,
+        backward_passes_per_step=backward_passes_per_step,
+    )

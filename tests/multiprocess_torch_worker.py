@@ -1,0 +1,151 @@
+"""Worker: the ``horovod_tpu.torch`` adapter under REAL process separation
+— the reference's exact model (one process per device, torch CPU tensors,
+mpirun-style launch).  Mirrors the reference's test_torch.py core matrix:
+allreduce value/average, allgather, broadcast, broadcast_parameters,
+broadcast_optimizer_state round-trip, and hook-based DistributedOptimizer
+training that keeps ranks bit-identical.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    n = hvd.size()
+    me = hvd.rank()
+    assert n == 2, n
+
+    # --- allreduce: average and sum of per-rank tensors.
+    t = torch.arange(4, dtype=torch.float32) + me
+    avg = hvd.allreduce(t, average=True, name="t.avg")
+    assert torch.allclose(avg, torch.arange(4, dtype=torch.float32) + 0.5), avg
+    s = hvd.allreduce(t, average=False, name="t.sum")
+    assert torch.allclose(s, 2 * torch.arange(4, dtype=torch.float32) + 1), s
+    # in-place
+    t2 = torch.full((3,), float(me))
+    hvd.allreduce_(t2, average=False, name="t.inplace")
+    assert torch.allclose(t2, torch.full((3,), 1.0)), t2
+
+    # --- allgather along dim 0.
+    g = hvd.allgather(torch.full((2, 2), float(me)), name="t.gather")
+    assert g.shape == (4, 2)
+    assert torch.allclose(g[:2], torch.zeros(2, 2))
+    assert torch.allclose(g[2:], torch.ones(2, 2))
+
+    # --- broadcast.
+    b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
+    assert torch.allclose(b, torch.full((2,), 6.0)), b
+
+    # --- broadcast_parameters on a real module.
+    torch.manual_seed(me)              # ranks start DIFFERENT
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2)
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    probe = hvd.allgather(model[0].weight.data.reshape(1, -1),
+                          name="t.wcheck")
+    assert torch.allclose(probe[0], probe[1]), "params differ after bcast"
+
+    # --- hook-based DistributedOptimizer: identical data → ranks must stay
+    # bit-identical; different per-rank data → grads are averaged.
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9),
+        named_parameters=model.named_parameters(),
+    )
+    rng = np.random.RandomState(7 + me)          # per-rank data
+    x = torch.from_numpy(rng.randn(16, 4).astype(np.float32))
+    y = torch.from_numpy(rng.randn(16, 2).astype(np.float32))
+    first = last = None
+    for _ in range(12):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        last = float(loss)
+        first = first if first is not None else last
+    assert last < first, (first, last)
+    probe = hvd.allgather(model[0].weight.data.reshape(1, -1),
+                          name="t.wcheck2")
+    assert torch.allclose(probe[0], probe[1], atol=1e-6), (
+        "ranks diverged under the hook optimizer"
+    )
+
+    # --- broadcast_optimizer_state: momentum buffers + scalars round-trip.
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    sd = opt.state_dict()
+    assert sd["param_groups"][0]["lr"] == 0.05
+    n_bufs = sum(
+        1 for st in sd["state"].values() if "momentum_buffer" in st
+    )
+    assert n_bufs > 0, "no momentum buffers survived the round-trip"
+
+    # --- HETEROGENEOUS state: rank 1 builds a FRESH optimizer (no state)
+    # and syncs from the stepped root — the restore-then-sync pattern.
+    fresh_model = torch.nn.Linear(4, 2)
+    hvd.broadcast_parameters(fresh_model.state_dict(), root_rank=0)
+    fresh = torch.optim.SGD(fresh_model.parameters(), lr=0.03, momentum=0.9)
+    if me == 0:  # ONLY root steps, so only root has momentum buffers
+        out = fresh_model(torch.ones(4, 4)).sum()
+        out.backward()
+        fresh.step()
+        fresh.zero_grad()
+    hvd.broadcast_optimizer_state(fresh, root_rank=0)
+    fsd = fresh.state_dict()
+    bufs = [st["momentum_buffer"] for st in fsd["state"].values()
+            if "momentum_buffer" in st]
+    assert bufs, "fresh worker did not receive the root's momentum buffers"
+    bcheck = hvd.allgather(bufs[0].reshape(1, -1), name="t.freshbuf")
+    assert torch.allclose(bcheck[0], bcheck[1]), "state differs after sync"
+
+    # --- Force-allreduce: ranks produce grads for DISJOINT heads (the
+    # reference's test_force_allreduce two-headed net); step() must not
+    # deadlock and ranks must stay identical.
+    class TwoHead(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.trunk = torch.nn.Linear(4, 4)
+            self.head_a = torch.nn.Linear(4, 1)
+            self.head_b = torch.nn.Linear(4, 1)
+
+        def forward(self, x, which):
+            h = torch.tanh(self.trunk(x))
+            return (self.head_a if which == 0 else self.head_b)(h)
+
+    torch.manual_seed(0)
+    th = TwoHead()
+    hvd.broadcast_parameters(th.state_dict(), root_rank=0)
+    topt = hvd.DistributedOptimizer(
+        torch.optim.SGD(th.parameters(), lr=0.05),
+        named_parameters=th.named_parameters(),
+    )
+    for _ in range(3):
+        topt.zero_grad()
+        loss = th(torch.ones(8, 4), me).pow(2).mean()   # rank-disjoint head
+        loss.backward()
+        topt.step()                                      # must not deadlock
+    wcheck = hvd.allgather(th.head_a.weight.data.reshape(1, -1),
+                           name="t.heads")
+    assert torch.allclose(wcheck[0], wcheck[1], atol=1e-6), (
+        "ranks diverged under disjoint-grad force-allreduce"
+    )
+
+    hvd.shutdown()
+    print("TORCH_OK " + json.dumps({"rank": me, "size": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -266,3 +266,34 @@ def test_launcher_restarts_rejected_multihost():
     )
     assert r.returncode == 2
     assert "external supervisor" in r.stderr
+
+
+@pytest.mark.slow
+def test_torch_adapter_two_processes():
+    """horovod_tpu.torch under the reference's exact process model: two OS
+    processes, one CPU device each, torch tensors on the wire, hook-based
+    DistributedOptimizer keeping ranks identical."""
+    outs = _run_workers(
+        os.path.join(HERE, "multiprocess_torch_worker.py"), 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+        },
+    )
+    for i, out in enumerate(outs):
+        assert "TORCH_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+def test_torch_adapter_rejects_multi_device_controller():
+    """In a single-controller multi-device world the torch adapter must
+    refuse with a pointer to the JAX-native API — and leave the world
+    SHUT DOWN so that pointer's advice (re-init natively) actually works."""
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvdt
+
+    try:
+        with pytest.raises(RuntimeError, match="ONE device per process"):
+            hvdt.init()
+        assert not hvd.is_initialized()
+    finally:
+        hvd.init()   # restore the session world for later tests
