@@ -114,6 +114,25 @@ def _maybe_init_distributed() -> None:
         _distributed_initialized = True
 
 
+def _honor_platform_env() -> None:
+    """Make the ``JAX_PLATFORMS`` env var actually win.
+
+    Site-customize-installed TPU plugins may force ``jax_platforms`` via
+    ``jax.config`` at interpreter start, which silently outranks the env
+    var — so ``horovodrun-tpu --cpu`` (which sets JAX_PLATFORMS=cpu in the
+    worker env) would still try to grab the TPU and hang if its tunnel is
+    down.  Re-assert the env var before the first backend touch; a no-op
+    when they already agree or the backend exists."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass
+
+
 def init(
     devices: Sequence[jax.Device] | None = None,
     mesh: Mesh | None = None,
@@ -131,6 +150,7 @@ def init(
     with _state.lock:
         if _state.initialized:
             return
+        _honor_platform_env()
         _maybe_init_distributed()
         if mesh is not None:
             if len(mesh.axis_names) != 1:

@@ -297,3 +297,26 @@ def test_torch_adapter_rejects_multi_device_controller():
         assert not hvd.is_initialized()
     finally:
         hvd.init()   # restore the session world for later tests
+
+
+@pytest.mark.slow
+def test_pytorch_mnist_example_via_launcher():
+    """The reference's headline torch example, launched the reference way
+    (one process per device) — convergence smoke across 2 real processes."""
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    # The example is run as a script (its dir joins sys.path, the repo root
+    # does not); an installed package wouldn't need this.
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable,
+         os.path.join(os.path.dirname(HERE), "examples", "pytorch_mnist.py"),
+         "--epochs", "1", "--samples", "256", "--batch-size", "16"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "final loss (rank-averaged):" in r.stdout
