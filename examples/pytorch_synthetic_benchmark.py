@@ -1,0 +1,106 @@
+"""PyTorch synthetic benchmark through ``horovod_tpu.torch`` — the
+reference's in-repo harness shape (reference
+examples/pytorch_synthetic_benchmark.py:96-110): random data, wrapped
+optimizer, img/sec per worker as mean ± 1.96σ over ``--num-iters`` groups
+of ``--num-batches-per-iter`` batches, plus the total.
+
+The reference benches torchvision's resnet50; this image ships no
+torchvision, so the default model is a compact self-contained ConvNet
+(``--model mlp`` for an even lighter run).  One process per device:
+
+    python -m horovod_tpu.launch --nproc 2 --cpu -- \
+        python examples/pytorch_synthetic_benchmark.py --smoke
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class ConvNet(torch.nn.Module):
+    def __init__(self, classes: int = 1000):
+        super().__init__()
+        self.c1 = torch.nn.Conv2d(3, 32, 3, stride=2, padding=1)
+        self.c2 = torch.nn.Conv2d(32, 64, 3, stride=2, padding=1)
+        self.c3 = torch.nn.Conv2d(64, 128, 3, stride=2, padding=1)
+        self.fc = torch.nn.Linear(128, classes)
+
+    def forward(self, x):
+        x = F.relu(self.c1(x))
+        x = F.relu(self.c2(x))
+        x = F.relu(self.c3(x))
+        return self.fc(x.mean(dim=(2, 3)))
+
+
+class Mlp(torch.nn.Module):
+    def __init__(self, classes: int = 1000):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(3 * 32 * 32, 256)
+        self.fc2 = torch.nn.Linear(256, classes)
+
+    def forward(self, x):
+        return self.fc2(torch.tanh(self.fc1(x.flatten(1))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="convnet", choices=["convnet", "mlp"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.image_size = 32
+        args.num_iters, args.num_batches_per_iter = 2, 2
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = (ConvNet if args.model == "convnet" else Mlp)()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size(),
+                        momentum=0.9),
+        named_parameters=model.named_parameters(),
+    )
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def benchmark_step():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}  Batch size: {args.batch_size}  "
+              f"Workers: {hvd.size()}")
+    benchmark_step()                         # warmup (compile dispatches)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        rate = (args.batch_size * args.num_batches_per_iter
+                / (time.perf_counter() - t0))
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec per worker")
+
+    mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    if hvd.rank() == 0:
+        print(f"Img/sec per worker: {mean:.1f} +-{conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} worker(s): "
+              f"{mean * hvd.size():.1f} +-{conf * hvd.size():.1f}")
+
+
+if __name__ == "__main__":
+    main()

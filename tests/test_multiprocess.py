@@ -320,3 +320,23 @@ def test_pytorch_mnist_example_via_launcher():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "final loss (rank-averaged):" in r.stdout
+
+
+@pytest.mark.slow
+def test_pytorch_synthetic_benchmark_via_launcher():
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--", sys.executable,
+         os.path.join(os.path.dirname(HERE), "examples",
+                      "pytorch_synthetic_benchmark.py"),
+         "--smoke", "--model", "mlp", "--batch-size", "4"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Total img/sec on 2 worker(s):" in r.stdout
