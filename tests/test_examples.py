@@ -114,3 +114,18 @@ def test_scaling_benchmark_smoke():
         "scaling_benchmark.py",
         ["--model", "mlp", "--bs", "2", "--iters", "1", "--batches", "1"],
     )
+
+
+def test_keras_mnist_basic(tmp_path):
+    run_example(
+        "keras_mnist.py",
+        ["--epochs", "1", "--batch-per-chip", "4"],
+    )
+
+
+def test_jax_mnist_estimator(tmp_path):
+    run_example(
+        "jax_mnist_estimator.py",
+        ["--train-steps", "4", "--eval-every", "2", "--batch-per-chip", "4",
+         "--ckpt-dir", str(tmp_path)],
+    )
