@@ -50,6 +50,15 @@ def main() -> None:
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
     assert torch.allclose(b, torch.full((2,), 6.0)), b
 
+    # --- compression and Adasum ride the torch surface too.
+    c = hvd.allreduce(torch.full((2048,), float(me + 1)), average=True,
+                      name="t.int8", compression=hvd.Compression.int8)
+    assert torch.allclose(c, torch.full((2048,), 1.5), atol=0.05), c[:3]
+    ortho = torch.zeros(2)
+    ortho[me] = float(me + 1)
+    ad = hvd.allreduce(ortho, name="t.adasum", op=hvd.Adasum)
+    assert torch.allclose(ad, torch.tensor([1.0, 2.0]), atol=1e-5), ad
+
     # --- broadcast_parameters on a real module.
     torch.manual_seed(me)              # ranks start DIFFERENT
     model = torch.nn.Sequential(

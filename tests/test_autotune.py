@@ -149,3 +149,33 @@ def test_engine_autotunes_under_eager_traffic():
             os.environ.pop(k, None)
         hvd.shutdown()
         hvd.init()
+
+
+def test_autotune_ignored_with_native_controller(capsys):
+    """HOROVOD_AUTOTUNE with the native controller must warn and disable
+    (rank 0's fixed threshold owns fusion for every rank)."""
+    import uuid
+
+    from horovod_tpu import native
+
+    if not native.available():
+        pytest.skip("libhvdtpu.so unavailable")
+    hvd.shutdown()
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    os.environ["HOROVOD_TPU_CONTROLLER_TRANSPORT"] = f"local:{uuid.uuid4().hex}"
+    try:
+        hvd.init()
+        x = hvd.per_rank(lambda r: jnp.full((4,), float(r)))
+        hvd.allreduce(x, average=True)          # brings the engine up
+        eng = hvd.ops.eager._engine()
+        assert eng.controller is not None
+        assert eng.autotuner is None
+        err = capsys.readouterr().err
+        assert "HOROVOD_AUTOTUNE=1 ignored" in err
+    finally:
+        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_TPU_NATIVE_CONTROLLER",
+                  "HOROVOD_TPU_CONTROLLER_TRANSPORT"):
+            os.environ.pop(k, None)
+        hvd.shutdown()
+        hvd.init()
