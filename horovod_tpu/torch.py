@@ -119,7 +119,22 @@ def allgather_async(tensor, name=None) -> int:
 
 
 def allgather(tensor, name=None):
-    return synchronize(allgather_async(tensor, name))
+    """Concatenate every rank's tensor along dim 0.  Ranks may disagree on
+    dim 0 (the reference's unequal-first-dim allgather,
+    operations.cc:841-901): sizes are negotiated host-side via an object
+    allgather, locals pad to the max, and the result is sliced ragged."""
+    d0 = int(tensor.shape[0]) if tensor.dim() else 1
+    sizes = _hvd.allgather_object(d0)
+    if len(set(sizes)) == 1:
+        return synchronize(allgather_async(tensor, name))
+    torch = _torch()
+    pad = max(sizes)
+    padded = torch.zeros((pad,) + tuple(tensor.shape[1:]),
+                         dtype=tensor.dtype)
+    padded[:d0] = tensor
+    full = synchronize(allgather_async(padded, name))   # [n*pad, ...]
+    pieces = [full[r * pad:r * pad + s] for r, s in enumerate(sizes)]
+    return torch.cat(pieces, dim=0)
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:

@@ -46,6 +46,13 @@ def main() -> None:
     assert torch.allclose(g[:2], torch.zeros(2, 2))
     assert torch.allclose(g[2:], torch.ones(2, 2))
 
+    # --- RAGGED allgather: ranks disagree on dim 0 (the reference's
+    # unequal-first-dim capability, operations.cc:841-901).
+    rg = hvd.allgather(torch.full((me + 1, 2), float(me)), name="t.ragged")
+    assert rg.shape == (3, 2), rg.shape
+    assert torch.allclose(rg[:1], torch.zeros(1, 2))
+    assert torch.allclose(rg[1:], torch.ones(2, 2))
+
     # --- broadcast.
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
     assert torch.allclose(b, torch.full((2,), 6.0)), b
