@@ -152,6 +152,37 @@ def broadcast_(tensor, root_rank, name=None):
     return tensor
 
 
+def sparse_allreduce_async(tensor, name=None, *, average: bool = False,
+                           ratio: float = 0.01, k: int | None = None) -> int:
+    """The fork's top-k sparse allreduce on torch tensors (reference
+    horovod/torch/__init__.py:46-83: mpi4py Allgatherv of nonzero
+    values+indices; here top_k → allgather → scatter-add, compiled)."""
+    return _eager.sparse_allreduce_async(
+        _to_rank_major(tensor), name=name, average=average, ratio=ratio, k=k
+    )
+
+
+def sparse_allreduce(tensor, name=None, *, average: bool = False,
+                     ratio: float = 0.01, k: int | None = None):
+    return synchronize(
+        sparse_allreduce_async(tensor, name, average=average, ratio=ratio,
+                               k=k)
+    )
+
+
+def grouped_allreduce(tensors, average=True, *, op=None,
+                      compression=Compression.none):
+    """Allreduce many tensors as one fusion group (the grouped API later
+    Horovod grew in 0.21) — one caller-delimited bucket through the
+    engine, deterministic across hosts."""
+    if op is None:
+        op = Average if average else Sum
+    outs = _eager.grouped_allreduce_eager(
+        [_to_rank_major(t) for t in tensors], op=op, compression=compression
+    )
+    return [_to_torch(o) for o in outs]
+
+
 def poll(handle: int) -> bool:
     return _eager.poll(handle)
 

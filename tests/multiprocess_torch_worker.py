@@ -57,6 +57,23 @@ def main() -> None:
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
     assert torch.allclose(b, torch.full((2,), 6.0)), b
 
+    # --- the fork's sparse top-k path on torch tensors.
+    sp = torch.zeros(16)
+    sp[me * 2] = 5.0            # each rank's single dominant entry
+    sp[me * 2 + 1] = 0.001      # dropped by k=1
+    out_sp = hvd.sparse_allreduce(sp, name="t.sparse", k=1)
+    want = torch.zeros(16)
+    want[0] = 5.0
+    want[2] = 5.0
+    assert torch.allclose(out_sp, want), out_sp
+
+    # --- grouped allreduce: one fusion group, many tensors.
+    group = hvd.grouped_allreduce(
+        [torch.full((4,), float(me + i)) for i in range(3)], average=True
+    )
+    for i, g in enumerate(group):
+        assert torch.allclose(g, torch.full((4,), 0.5 + i)), (i, g)
+
     # --- compression and Adasum ride the torch surface too.
     c = hvd.allreduce(torch.full((2048,), float(me + 1)), average=True,
                       name="t.int8", compression=hvd.Compression.int8)
