@@ -114,27 +114,85 @@ def allreduce_(tensor, average=True, name=None, *, op=None,
     return tensor
 
 
+# Handle → (pad, per-rank sizes) for ragged allgathers; synchronize()
+# applies the slicing so the async surface supports unequal dims too.
+_ragged_post: dict = {}
+
+_MAX_GATHER_NDIM = 8
+
+
+def _negotiate_gather_shapes(tensor, name):
+    """Exchange (ndim, dtype, shape) across ranks THROUGH the engine — not
+    an out-of-band host collective, so it serializes with every queued
+    engine op (no cross-host op-order divergence) and the result is
+    rank-ordered like the gathered rows themselves.  Returns the CPU copy
+    of the local tensor and the per-rank dim-0 sizes; raises the same
+    clean errors as the eager list form for trailing-dim/dtype mismatch."""
+    torch = _torch()
+    local = tensor.detach().cpu()
+    if local.dim() < 1:
+        raise ValueError("allgather expects a tensor with >= 1 dim")
+    if local.dim() > _MAX_GATHER_NDIM:
+        raise ValueError(
+            f"allgather supports up to {_MAX_GATHER_NDIM} dims, got "
+            f"{local.dim()}"
+        )
+    import zlib
+
+    # int32 end-to-end: jax's default x64-truncation would silently fold
+    # int64 digests and break the cross-rank comparison.
+    digest = np.zeros((2 + _MAX_GATHER_NDIM,), np.int32)
+    digest[0] = local.dim()
+    # crc32, not hash(): Python's str hash is per-process randomized.
+    digest[1] = zlib.crc32(str(local.dtype).encode()) & 0x7FFFFFFF
+    digest[2:2 + local.dim()] = list(local.shape)
+    import jax
+
+    h = _eager.allgather_async(
+        _to_rank_major(torch.from_numpy(digest)),
+        name=None if name is None else f"{name}.shapes",
+    )
+    all_digest = np.asarray(
+        jax.device_get(_eager.synchronize(h))
+    ).reshape(size(), 2 + _MAX_GATHER_NDIM)
+    for r in range(size()):
+        if all_digest[r, 0] != local.dim() or all_digest[r, 1] != digest[1]:
+            raise ValueError(
+                "allgather: per-rank tensors must share ndim and dtype; "
+                f"rank {r} disagrees ({all_digest[r, :2].tolist()} vs "
+                f"{digest[:2].tolist()})"
+            )
+        if list(all_digest[r, 3:2 + local.dim()]) != list(local.shape[1:]):
+            raise ValueError(
+                "allgather: per-rank tensors must agree on all dims except "
+                f"dim 0; rank {r} has trailing {all_digest[r, 3:2 + local.dim()].tolist()}"
+                f" vs local {list(local.shape[1:])}"
+            )
+    sizes = [int(all_digest[r, 2]) for r in range(size())]
+    return local, sizes
+
+
 def allgather_async(tensor, name=None) -> int:
-    return _eager.allgather_async(_to_rank_major(tensor), name=name)
+    """Async allgather along dim 0; ranks may disagree on dim 0 (the
+    reference's unequal-first-dim allgather, operations.cc:841-901).
+    Sizes are negotiated through the engine up front; ``synchronize``
+    returns the ragged concatenation."""
+    torch = _torch()
+    local, sizes = _negotiate_gather_shapes(tensor, name)
+    pad = max(sizes)
+    if local.shape[0] != pad:
+        padded = torch.zeros((pad,) + tuple(local.shape[1:]),
+                             dtype=local.dtype)
+        padded[:local.shape[0]] = local
+        local = padded
+    h = _eager.allgather_async(_to_rank_major(local), name=name)
+    if len(set(sizes)) > 1:
+        _ragged_post[h] = (pad, sizes)
+    return h
 
 
 def allgather(tensor, name=None):
-    """Concatenate every rank's tensor along dim 0.  Ranks may disagree on
-    dim 0 (the reference's unequal-first-dim allgather,
-    operations.cc:841-901): sizes are negotiated host-side via an object
-    allgather, locals pad to the max, and the result is sliced ragged."""
-    d0 = int(tensor.shape[0]) if tensor.dim() else 1
-    sizes = _hvd.allgather_object(d0)
-    if len(set(sizes)) == 1:
-        return synchronize(allgather_async(tensor, name))
-    torch = _torch()
-    pad = max(sizes)
-    padded = torch.zeros((pad,) + tuple(tensor.shape[1:]),
-                         dtype=tensor.dtype)
-    padded[:d0] = tensor
-    full = synchronize(allgather_async(padded, name))   # [n*pad, ...]
-    pieces = [full[r * pad:r * pad + s] for r, s in enumerate(sizes)]
-    return torch.cat(pieces, dim=0)
+    return synchronize(allgather_async(tensor, name))
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
@@ -188,7 +246,15 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
-    return _to_torch(_eager.synchronize(handle))
+    out = _to_torch(_eager.synchronize(handle))
+    post = _ragged_post.pop(handle, None)
+    if post is not None:
+        torch = _torch()
+        pad, sizes = post
+        out = torch.cat(
+            [out[r * pad:r * pad + s] for r, s in enumerate(sizes)], dim=0
+        )
+    return out
 
 
 # ------------------------------------------------------------- state sync

@@ -47,11 +47,24 @@ def main() -> None:
     assert torch.allclose(g[2:], torch.ones(2, 2))
 
     # --- RAGGED allgather: ranks disagree on dim 0 (the reference's
-    # unequal-first-dim capability, operations.cc:841-901).
+    # unequal-first-dim capability, operations.cc:841-901) — blocking AND
+    # async surfaces, sizes negotiated through the engine.
     rg = hvd.allgather(torch.full((me + 1, 2), float(me)), name="t.ragged")
     assert rg.shape == (3, 2), rg.shape
     assert torch.allclose(rg[:1], torch.zeros(1, 2))
     assert torch.allclose(rg[1:], torch.ones(2, 2))
+    rh = hvd.allgather_async(torch.full((2 - me, 3), float(me)),
+                             name="t.ragged2")
+    rg2 = hvd.synchronize(rh)
+    assert rg2.shape == (3, 3), rg2.shape
+    assert torch.allclose(rg2[:2], torch.zeros(2, 3))
+    assert torch.allclose(rg2[2:], torch.ones(1, 3))
+    # Trailing-dim mismatch raises cleanly on every rank.
+    try:
+        hvd.allgather(torch.zeros((1, 2 + me)), name="t.badragged")
+        raise AssertionError("trailing-dim mismatch not detected")
+    except ValueError as e:
+        assert "agree on all dims except" in str(e), e
 
     # --- broadcast.
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
