@@ -130,6 +130,28 @@ def main() -> None:
         "ranks diverged under the hook optimizer"
     )
 
+    # --- backward_passes_per_step: 2 local accumulations per flush.
+    acc_model = torch.nn.Linear(4, 2)
+    hvd.broadcast_parameters(acc_model.state_dict(), root_rank=0)
+    acc_opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(acc_model.parameters(), lr=0.05),
+        named_parameters=acc_model.named_parameters(),
+        backward_passes_per_step=2,
+    )
+    rng2 = np.random.RandomState(50 + me)
+    xa = torch.from_numpy(rng2.randn(8, 4).astype(np.float32))
+    ya = torch.from_numpy(rng2.randn(8, 2).astype(np.float32))
+    for _ in range(2):                       # two flush cycles
+        acc_opt.zero_grad()
+        torch.nn.functional.mse_loss(acc_model(xa), ya).backward()
+        torch.nn.functional.mse_loss(acc_model(xa), ya).backward()
+        acc_opt.step()
+    acheck = hvd.allgather(acc_model.weight.data.reshape(1, -1),
+                           name="t.accw")
+    assert torch.allclose(acheck[0], acheck[1], atol=1e-6), (
+        "ranks diverged under backward_passes_per_step"
+    )
+
     # --- broadcast_optimizer_state: momentum buffers + scalars round-trip.
     hvd.broadcast_optimizer_state(opt, root_rank=0)
     sd = opt.state_dict()
