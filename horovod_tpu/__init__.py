@@ -66,6 +66,8 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     allgather_async,
     allreduce,
     allreduce_async,
+    alltoall,
+    alltoall_async,
     broadcast,
     broadcast_async,
     grouped_allreduce_eager,

@@ -115,15 +115,18 @@ def _maybe_init_distributed() -> None:
 
 
 def _honor_platform_env() -> None:
-    """Make the ``JAX_PLATFORMS`` env var actually win.
+    """Make the launcher's platform choice actually win.
 
     Site-customize-installed TPU plugins may force ``jax_platforms`` via
-    ``jax.config`` at interpreter start, which silently outranks the env
-    var — so ``horovodrun-tpu --cpu`` (which sets JAX_PLATFORMS=cpu in the
-    worker env) would still try to grab the TPU and hang if its tunnel is
-    down.  Re-assert the env var before the first backend touch; a no-op
-    when they already agree or the backend exists."""
-    want = os.environ.get("JAX_PLATFORMS")
+    ``jax.config`` at interpreter start, which silently outranks the
+    ``JAX_PLATFORMS`` env var — so ``horovodrun-tpu --cpu`` workers would
+    still try to grab the TPU and hang if its tunnel is down.  The
+    launcher therefore sets its OWN variable,
+    ``HOROVOD_TPU_FORCE_PLATFORM``; only that is re-asserted here.  The
+    ambient ``JAX_PLATFORMS`` is deliberately NOT: it may predate the
+    process from the surrounding environment, and re-asserting it would
+    override a user's explicit in-script ``jax.config.update``."""
+    want = os.environ.get("HOROVOD_TPU_FORCE_PLATFORM")
     if not want:
         return
     try:

@@ -138,6 +138,9 @@ def _run_gang(args, cmd, world: int, coordinator: str,
         )
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
+            # The env var alone loses to sitecustomize-forced platform
+            # config; hvd.init() re-asserts THIS launcher-owned variable.
+            env["HOROVOD_TPU_FORCE_PLATFORM"] = "cpu"
             env.pop("XLA_FLAGS", None)
         proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,

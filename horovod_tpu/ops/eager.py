@@ -322,7 +322,8 @@ class EagerEngine:
         if tune_sample is not None:
             self.autotuner.observe(*tune_sample)
 
-    _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "sparse": 3}
+    _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
+                   "sparse": 3, "alltoall": 4}
 
     def _controller_group(self, p: _PendingOp) -> int:
         """Encode fusability (reduce op, compression) into the controller's
@@ -634,6 +635,24 @@ class EagerEngine:
                         axis=0,
                     )
                 self.handles.mark_dispatched(p.handle, gathered)
+            elif p.kind == "alltoall":
+                fn = self._dispatch_cache.get("a2a")
+                if fn is None:
+
+                    def a2a(x):
+                        # Per-rank block [1, m, ...] → split row into n
+                        # chunks, exchange, concat: rank r's output row is
+                        # chunk r of every rank (Horovod ≥0.20 hvd.alltoall
+                        # semantics, equal splits).
+                        out = lax.all_to_all(
+                            x[0], self._axis, split_axis=0, concat_axis=0,
+                            tiled=True,
+                        )
+                        return out[None]
+
+                    fn = self._shard_map(a2a, out_specs=P(self._axis))
+                    self._dispatch_cache["a2a"] = fn
+                self.handles.mark_dispatched(p.handle, fn(p.tensor))
             elif p.kind == "sparse":
                 topk = p.topk
                 key = ("sp", topk.ratio, topk.k, p.op.name)
@@ -846,6 +865,31 @@ def allgather(tensors, name: str | None = None, *, process_set=None):
     concatenation of MEMBER ranks' slices only (set order)."""
     return synchronize(allgather_async(tensors, name,
                                        process_set=process_set))
+
+
+def alltoall_async(tensor, name: str | None = None) -> int:
+    """Async all-to-all (the hvd.alltoall API Horovod grew in 0.20, equal
+    splits): rank r's row of the rank-major input is split into ``size``
+    chunks; its output row is chunk r from every rank.  The result is
+    RANK-MAJOR ``[size, m, ...]`` — per-rank values differ by design."""
+    eng = _engine()
+    t = _as_rank_major(tensor, "alltoall")
+    n = basics.size()
+    if t.ndim < 2 or t.shape[1] % n != 0:
+        raise ValueError(
+            f"alltoall expects rank-major [size, m, ...] with m divisible "
+            f"by size={n}; got {t.shape}"
+        )
+    name = name or _auto_name("alltoall")
+    h = eng.handles.allocate(name)
+    eng.enqueue(
+        _PendingOp(kind="alltoall", handle=h, tensor=t, name=name)
+    )
+    return h
+
+
+def alltoall(tensor, name: str | None = None):
+    return synchronize(alltoall_async(tensor, name))
 
 
 def broadcast_async(tensor, root_rank: int, name: str | None = None, *,

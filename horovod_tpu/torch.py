@@ -195,6 +195,17 @@ def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name))
 
 
+def alltoall(tensor, name=None):
+    """All-to-all with equal splits (hvd.alltoall, Horovod ≥0.20): this
+    process's tensor splits into ``size`` chunks along dim 0; the result
+    is chunk ``rank`` from every process, concatenated."""
+    torch = _torch()
+    h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
+    out = _eager.synchronize(h)              # rank-major [n, m, ...]
+    local = np.asarray(out.addressable_shards[0].data)[0]
+    return torch.from_numpy(np.array(local))
+
+
 def broadcast_async(tensor, root_rank, name=None) -> int:
     return _eager.broadcast_async(_to_rank_major(tensor), root_rank,
                                   name=name)

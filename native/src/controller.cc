@@ -32,6 +32,8 @@ const char* KindName(OpKind k) {
       return "broadcast";
     case OpKind::kSparse:
       return "sparse_allreduce";
+    case OpKind::kAlltoall:
+      return "alltoall";
   }
   return "?";
 }
@@ -94,6 +96,7 @@ void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
     switch (r.kind) {
       case OpKind::kAllreduce:
       case OpKind::kSparse:
+      case OpKind::kAlltoall:  // equal splits: identical shapes everywhere
         if (r.shape != f.shape)
           e.error = "Mismatched allreduce tensor shapes for " + r.name + ": " +
                     ShapeStr(f.shape) + " vs " + ShapeStr(r.shape);

@@ -23,6 +23,7 @@ enum class OpKind : uint8_t {
   kAllgather = 1,
   kBroadcast = 2,
   kSparse = 3,
+  kAlltoall = 4,
 };
 
 // Dtype vocabulary (JAX-facing; sizes used only for fusion accounting).

@@ -48,7 +48,7 @@ RequestList RandRequestList() {
   size_t n = Rand(0, 8);
   for (size_t i = 0; i < n; ++i) {
     Request r;
-    r.kind = static_cast<OpKind>(Rand(0, 3));
+    r.kind = static_cast<OpKind>(Rand(0, 4));
     r.dtype = static_cast<DType>(Rand(0, 9));
     r.rank = static_cast<int32_t>(Rand(0, 1023));
     r.root_rank = static_cast<int32_t>(g_rng());
@@ -68,7 +68,7 @@ BatchList RandBatchList() {
   size_t n = Rand(0, 8);
   for (size_t i = 0; i < n; ++i) {
     Batch b;
-    b.kind = static_cast<OpKind>(Rand(0, 3));
+    b.kind = static_cast<OpKind>(Rand(0, 4));
     b.error = RandStr(30);
     size_t m = Rand(0, 6);
     for (size_t j = 0; j < m; ++j) b.names.push_back(RandStr(24));

@@ -94,3 +94,23 @@ def test_sparse_allreduce_topk_selects_largest():
     expected = np.zeros(4)
     expected[2] = 5.0 * hvd.size()
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_eager_alltoall():
+    """hvd.alltoall (Horovod >=0.20 API): rank r's output row is chunk r
+    of every rank — a transpose of the chunk grid; result is rank-major."""
+    n = hvd.size()
+    # rank r's row = [r*n, r*n+1, ..., r*n+n-1] (one chunk per dest rank)
+    x = hvd.per_rank(lambda r: jnp.arange(n, dtype=jnp.float32) + r * n)
+    out = np.asarray(hvd.alltoall(x, name="a2a.t"))
+    assert out.shape == (n, n)
+    np.testing.assert_array_equal(
+        out, np.arange(n * n, dtype=np.float32).reshape(n, n).T
+    )
+
+
+def test_eager_alltoall_validates_divisibility():
+    n = hvd.size()
+    bad = hvd.per_rank(lambda r: jnp.zeros((n + 1,), jnp.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.alltoall_async(bad)
