@@ -876,9 +876,11 @@ def alltoall_async(tensor, name: str | None = None) -> int:
     t = _as_rank_major(tensor, "alltoall")
     n = basics.size()
     if t.ndim < 2 or t.shape[1] % n != 0:
+        # Report the PER-RANK shape: callers (esp. the torch surface)
+        # passed a per-rank tensor and never saw the rank-major wrapper.
         raise ValueError(
-            f"alltoall expects rank-major [size, m, ...] with m divisible "
-            f"by size={n}; got {t.shape}"
+            "alltoall expects each rank's dim 0 to be divisible by "
+            f"size={n}; got per-rank shape {t.shape[1:]}"
         )
     name = name or _auto_name("alltoall")
     h = eng.handles.allocate(name)

@@ -98,7 +98,8 @@ void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
       case OpKind::kSparse:
       case OpKind::kAlltoall:  // equal splits: identical shapes everywhere
         if (r.shape != f.shape)
-          e.error = "Mismatched allreduce tensor shapes for " + r.name + ": " +
+          e.error = std::string("Mismatched ") + KindName(r.kind) +
+                    " tensor shapes for " + r.name + ": " +
                     ShapeStr(f.shape) + " vs " + ShapeStr(r.shape);
         break;
       case OpKind::kAllgather:
