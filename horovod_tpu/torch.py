@@ -195,15 +195,24 @@ def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name))
 
 
-def alltoall(tensor, name=None):
-    """All-to-all with equal splits (hvd.alltoall, Horovod ≥0.20): this
-    process's tensor splits into ``size`` chunks along dim 0; the result
-    is chunk ``rank`` from every process, concatenated."""
-    torch = _torch()
+# Handles whose engine result is RANK-MAJOR (per-rank rows differ):
+# synchronize() extracts this process's row instead of device_get-ing the
+# whole array (which would fail on non-addressable multi-host shards).
+_rank_major_post: set = set()
+
+
+def alltoall_async(tensor, name=None) -> int:
+    """Async all-to-all with equal splits (hvd.alltoall_async, Horovod
+    ≥0.20): this process's tensor splits into ``size`` chunks along dim 0;
+    ``synchronize`` returns chunk ``rank`` from every process,
+    concatenated."""
     h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
-    out = _eager.synchronize(h)              # rank-major [n, m, ...]
-    local = np.asarray(out.addressable_shards[0].data)[0]
-    return torch.from_numpy(np.array(local))
+    _rank_major_post.add(h)
+    return h
+
+
+def alltoall(tensor, name=None):
+    return synchronize(alltoall_async(tensor, name))
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
@@ -257,7 +266,13 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
-    out = _to_torch(_eager.synchronize(handle))
+    raw = _eager.synchronize(handle)
+    if handle in _rank_major_post:
+        _rank_major_post.discard(handle)
+        torch = _torch()
+        local = np.asarray(raw.addressable_shards[0].data)[0]
+        return torch.from_numpy(np.array(local))
+    out = _to_torch(raw)
     post = _ragged_post.pop(handle, None)
     if post is not None:
         torch = _torch()

@@ -66,13 +66,16 @@ def main() -> None:
     except ValueError as e:
         assert "agree on all dims except" in str(e), e
 
-    # --- alltoall (equal splits): chunk r of every process.
+    # --- alltoall (equal splits): chunk r of every process; sync + async.
     a2a = hvd.alltoall(torch.arange(4, dtype=torch.float32) + 10 * me,
                        name="t.a2a")
     # rank0 row: chunk0 of each = [0,1, 10,11]; rank1: [2,3, 12,13]
     want_a2a = (torch.tensor([0.0, 1.0, 10.0, 11.0]) if me == 0
                 else torch.tensor([2.0, 3.0, 12.0, 13.0]))
     assert torch.allclose(a2a, want_a2a), a2a
+    ah = hvd.alltoall_async(torch.arange(4, dtype=torch.float32) + 10 * me,
+                            name="t.a2a.async")
+    assert torch.allclose(hvd.synchronize(ah), want_a2a)
 
     # --- broadcast.
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
