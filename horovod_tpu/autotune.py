@@ -23,6 +23,17 @@ completion, not just async dispatch — scores the current setting in
 bytes/sec, writes a log row, and either moves to a neighboring setting or,
 once no neighbor beats the incumbent, pins the best setting and stops.
 
+The score is **end-to-end cadence**, deliberately: the window's wall-clock
+spans inter-flush training compute, so ``score_bytes_per_sec`` measures how
+fast the whole train loop drains gradient traffic under a setting — the
+objective the user actually cares about — not isolated wire throughput.
+(An overlap-friendly setting that slows raw wire rate but hides it under
+compute SHOULD win.)  Before pinning a winner the tuner re-scores it once
+(a confirmation revisit); revisited settings average their samples rather
+than keeping the best, so one lucky noisy window can't entrench an
+incumbent — if the refreshed average drops below a neighbor, the search
+resumes from the new best.
+
 Enable with ``HOROVOD_AUTOTUNE=1``; ``HOROVOD_AUTOTUNE_LOG=<file>`` writes
 a CSV of (setting, score) rows — both knob names shared with later
 Horovod so launch scripts carry over.
@@ -72,9 +83,12 @@ class Autotuner:
         ci = _nearest(CYCLE_GRID_MS, config.cycle_time_ms)
         self._pos = (ti, ci)
         self._scores: dict[tuple[int, int], float] = {}
+        self._score_counts: dict[tuple[int, int], int] = {}
         self._pending: list[tuple[int, int]] = []
         self._coord = 0            # 0: tune threshold, 1: tune cycle time
         self._stale_coords = 0     # coords in a row with no improvement
+        self._confirmed = False    # incumbent re-scored before finishing?
+        self._best_seen: tuple[int, int] | None = None  # confirm target
         self._win_bytes = 0
         self._win_flushes = 0
         self._win_t0: float | None = None
@@ -114,7 +128,12 @@ class Autotuner:
                 pass
         elapsed = max(time.monotonic() - self._win_t0, 1e-9)
         score = self._win_bytes / elapsed
-        self._scores[self._pos] = max(self._scores.get(self._pos, 0.0), score)
+        # Running mean per setting: repeated visits refine the estimate
+        # instead of max() locking in one lucky noisy sample.
+        k = self._score_counts.get(self._pos, 0)
+        prev = self._scores.get(self._pos, 0.0)
+        self._scores[self._pos] = (prev * k + score) / (k + 1)
+        self._score_counts[self._pos] = k + 1
         self._log_row(score)
         self._win_bytes = 0
         self._win_flushes = 0
@@ -127,6 +146,13 @@ class Autotuner:
             # Current coordinate swept?  Candidates = unvisited neighbors of
             # the best point along the active coordinate.
             best = max(self._scores, key=self._scores.__getitem__)
+            if best != self._best_seen:
+                # The incumbent changed identity (first convergence, or a
+                # confirmation revisit demoted the old winner): whoever is
+                # best now must be (re-)confirmed before being pinned, even
+                # when it has no unexplored neighbors left.
+                self._best_seen = best
+                self._confirmed = False
             grid = THRESHOLD_GRID if self._coord == 0 else CYCLE_GRID_MS
             i = best[self._coord]
             neighbors = [
@@ -141,11 +167,25 @@ class Autotuner:
                 self._stale_coords += 1
                 self._coord ^= 1
                 if self._stale_coords >= 2:
-                    self._finish(best)
+                    if not self._confirmed:
+                        # Confirmation revisit: score the incumbent a second
+                        # time and AVERAGE with its earlier sample(s) (see
+                        # _close_window) before pinning it, so a single
+                        # noisy window can't entrench a winner.  If the
+                        # refreshed mean falls below a neighbor, the next
+                        # _advance() resumes from that new best.
+                        self._confirmed = True
+                        self._move_to(best)
+                        return
+                    self._finish(max(self._scores,
+                                     key=self._scores.__getitem__))
                     return
                 self._advance()
                 return
             self._stale_coords = 0
+            # New unexplored settings queued: whatever wins later must be
+            # (re-)confirmed before the search pins it.
+            self._confirmed = False
         self._move_to(self._pending.pop(0))
 
     def _move_to(self, pos: tuple[int, int]) -> None:

@@ -936,6 +936,24 @@ def poll(handle: int) -> bool:
     return eng.handles.poll(handle)
 
 
+def set_handle_post(handle: int, payload) -> None:
+    """Attach frontend post-processing state to a live handle (stored in the
+    HandleManager entry, under its lock, released with the handle)."""
+    _engine().handles.set_post(handle, payload)
+
+
+def take_handle_post(handle: int):
+    """Detach the handle's post payload; None if absent/released."""
+    return _engine().handles.take_post(handle)
+
+
+def release(handle: int) -> None:
+    """Drop a handle without waiting — frees its manager entry (and any
+    post payload).  No-op if already released.  For error-path cleanup
+    where blocking on the result is pointless."""
+    _engine().handles.release(handle)
+
+
 def synchronize(handle: int):
     """Block until the op completes; returns its output
     (reference torch/mpi_ops.py:422-438)."""

@@ -28,6 +28,7 @@ class _Entry:
     error: BaseException | None = None
     dispatched: bool = False
     name: str | None = None      # tensor name, for timeline attribution
+    post: Any = None             # frontend post-processing payload (opaque)
 
 
 class HandleManager:
@@ -48,6 +49,27 @@ class HandleManager:
             e = self._entries.get(handle)
             return e.name if e is not None else None
 
+    def set_post(self, handle: int, payload: Any) -> None:
+        """Attach a frontend post-processing payload to a live handle.
+
+        The payload lives and dies with the entry — released by ``wait``,
+        ``release``, and error paths alike — so frontends need no side
+        tables keyed by handle (which leak when ``synchronize`` raises or a
+        caller abandons a handle)."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is not None:
+                e.post = payload
+
+    def take_post(self, handle: int) -> Any:
+        """Detach and return the handle's post payload (None if absent)."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                return None
+            payload, e.post = e.post, None
+            return payload
+
     def _get(self, handle: int) -> _Entry:
         with self._lock:
             try:
@@ -58,13 +80,23 @@ class HandleManager:
                 ) from None
 
     def mark_dispatched(self, handle: int, result: Any) -> None:
-        e = self._get(handle)
+        # Tolerate released handles: an error-path release() can drop a
+        # handle while its _PendingOp is still queued in the engine; the
+        # eventual dispatch must not blow up mid-batch (which would leave
+        # fused-group peers unmarked and their waiters blocked forever).
+        with self._lock:
+            e = self._entries.get(handle)
+        if e is None:
+            return
         e.result = result
         e.dispatched = True
         e.event.set()
 
     def mark_error(self, handle: int, err: BaseException) -> None:
-        e = self._get(handle)
+        with self._lock:
+            e = self._entries.get(handle)
+        if e is None:
+            return
         e.error = err
         e.dispatched = True
         e.event.set()

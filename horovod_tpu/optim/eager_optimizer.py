@@ -118,6 +118,12 @@ class EagerDistributedOptimizer:
         self._loss_handle: int | None = None
         self._grad_fn_cache: dict[int, Callable] = {}
         self._residuals: dict[str, jax.Array] = {}
+        # handle → (grad name, residual-to-commit): the residual write is
+        # DEFERRED until the handle drains successfully in synchronize();
+        # committing at enqueue time would absorb the dropped component
+        # into EF state even when the collective errors and the step is
+        # retried (advisor r2).
+        self._pending_residuals: dict[int, tuple[str, jax.Array]] = {}
         self._handle_dtypes: dict[int, Any] = {}
 
     def init(self, params: Any):
@@ -217,7 +223,7 @@ class EagerDistributedOptimizer:
                 corrected, name=name, op=self.op,
                 compression=cls, no_fuse=True,
             )
-        self._residuals[name] = corrected - transmitted
+        self._pending_residuals[h] = (name, corrected - transmitted)
         # The wire moved fp32; restore the caller's grad dtype on drain so
         # opt_state dtypes match init (the compiled path's .astype(g.dtype)).
         self._handle_dtypes[h] = g.dtype
@@ -236,12 +242,36 @@ class EagerDistributedOptimizer:
             leaves = self._local_grads
         else:
             leaves = []
-            for _, h in self._handles:
-                out = eager_ops.synchronize(h)
-                want = self._handle_dtypes.pop(h, None)
-                if want is not None and out.dtype != want:
-                    out = out.astype(want)
-                leaves.append(out)
+            commits: list[tuple[str, jax.Array]] = []
+            try:
+                for _, h in self._handles:
+                    out = eager_ops.synchronize(h)
+                    pend = self._pending_residuals.pop(h, None)
+                    if pend is not None:
+                        commits.append(pend)
+                    want = self._handle_dtypes.pop(h, None)
+                    if want is not None and out.dtype != want:
+                        out = out.astype(want)
+                    leaves.append(out)
+                # Commit EF residuals only after the WHOLE drain succeeded:
+                # a mid-loop failure discards every reduced gradient (the
+                # caller retries the step), so residuals of already-drained
+                # handles must stay at their prior values too — their
+                # transmitted components were never applied to params.
+                for name_r, res in commits:
+                    self._residuals[name_r] = res
+            except BaseException:
+                # Failed drain: release EVERY undrained handle and drop its
+                # bookkeeping so EF state keeps the PRIOR residuals (the
+                # dropped components were never transmitted) and a retried
+                # backward()+step() starts from clean handle state instead
+                # of re-waiting on released handles.
+                for _, h in self._handles:
+                    self._pending_residuals.pop(h, None)
+                    self._handle_dtypes.pop(h, None)
+                    eager_ops.release(h)
+                self._handles = []
+                raise
         self._handles = []
         return jax.tree.unflatten(self._treedef, leaves)
 

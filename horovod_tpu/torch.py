@@ -114,10 +114,10 @@ def allreduce_(tensor, average=True, name=None, *, op=None,
     return tensor
 
 
-# Handle → (pad, per-rank sizes) for ragged allgathers; synchronize()
-# applies the slicing so the async surface supports unequal dims too.
-_ragged_post: dict = {}
-
+# Post-processing for ragged allgathers / rank-major results rides the
+# HandleManager entry itself (set_handle_post/take_handle_post) — under the
+# manager's lock, released with the handle — so an abandoned handle or a
+# raising synchronize() cannot leak frontend bookkeeping.
 _MAX_GATHER_NDIM = 8
 
 
@@ -140,7 +140,13 @@ def _negotiate_gather_shapes(tensor, name):
     import zlib
 
     # int32 end-to-end: jax's default x64-truncation would silently fold
-    # int64 digests and break the cross-rank comparison.
+    # int64 digests and break the cross-rank comparison.  Dims that don't
+    # fit int32 would wrap silently, so reject them up front.
+    if any(d > 0x7FFFFFFF for d in local.shape):
+        raise ValueError(
+            "allgather: tensor dims must fit in int32 for the cross-rank "
+            f"shape negotiation; got shape {tuple(local.shape)}"
+        )
     digest = np.zeros((2 + _MAX_GATHER_NDIM,), np.int32)
     digest[0] = local.dim()
     # crc32, not hash(): Python's str hash is per-process randomized.
@@ -187,7 +193,7 @@ def allgather_async(tensor, name=None) -> int:
         local = padded
     h = _eager.allgather_async(_to_rank_major(local), name=name)
     if len(set(sizes)) > 1:
-        _ragged_post[h] = (pad, sizes)
+        _eager.set_handle_post(h, ("ragged", (pad, sizes)))
     return h
 
 
@@ -195,19 +201,16 @@ def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name))
 
 
-# Handles whose engine result is RANK-MAJOR (per-rank rows differ):
-# synchronize() extracts this process's row instead of device_get-ing the
-# whole array (which would fail on non-addressable multi-host shards).
-_rank_major_post: set = set()
-
-
 def alltoall_async(tensor, name=None) -> int:
     """Async all-to-all with equal splits (hvd.alltoall_async, Horovod
     ≥0.20): this process's tensor splits into ``size`` chunks along dim 0;
     ``synchronize`` returns chunk ``rank`` from every process,
-    concatenated."""
+    concatenated.  The result is RANK-MAJOR (per-rank rows differ), so
+    ``synchronize`` extracts this process's row instead of device_get-ing
+    the whole array (which would fail on non-addressable multi-host
+    shards) — flagged via the handle's post payload."""
     h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
-    _rank_major_post.add(h)
+    _eager.set_handle_post(h, ("rank_major", None))
     return h
 
 
@@ -266,17 +269,19 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
+    # Detach the post payload BEFORE waiting: if the wait raises, the
+    # payload is already off the entry and the entry itself is released by
+    # the manager's error path — nothing to leak either way.
+    post = _eager.take_handle_post(handle)
     raw = _eager.synchronize(handle)
-    if handle in _rank_major_post:
-        _rank_major_post.discard(handle)
+    if post is not None and post[0] == "rank_major":
         torch = _torch()
         local = np.asarray(raw.addressable_shards[0].data)[0]
         return torch.from_numpy(np.array(local))
     out = _to_torch(raw)
-    post = _ragged_post.pop(handle, None)
-    if post is not None:
+    if post is not None and post[0] == "ragged":
         torch = _torch()
-        pad, sizes = post
+        pad, sizes = post[1]
         out = torch.cat(
             [out[r * pad:r * pad + s] for r, s in enumerate(sizes)], dim=0
         )
@@ -444,6 +449,12 @@ class _DistributedOptimizer:
         return self._opt.zero_grad(*a, **k)
 
     def __getattr__(self, item):
+        # Only reached when normal lookup fails.  Guard _opt itself: during
+        # unpickling/copy __init__ hasn't run, and delegating would recurse
+        # (self._opt → __getattr__("_opt") → ...) into RecursionError
+        # instead of the AttributeError pickle expects.
+        if item == "_opt":
+            raise AttributeError(item)
         return getattr(self._opt, item)
 
     @property
