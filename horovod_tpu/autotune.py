@@ -89,6 +89,12 @@ class Autotuner:
         self._stale_coords = 0     # coords in a row with no improvement
         self._confirmed = False    # incumbent re-scored before finishing?
         self._best_seen: tuple[int, int] | None = None  # confirm target
+        # Bound on confirmation revisits: two settings with statistically
+        # equal means could otherwise flip the argmax forever, each flip
+        # paying a warmup + scoring window.  After the budget is spent the
+        # tuner pins whatever is best — the candidates are equivalent
+        # anyway, that's WHY they keep flipping.
+        self._confirm_budget = 3
         self._win_bytes = 0
         self._win_flushes = 0
         self._win_t0: float | None = None
@@ -167,7 +173,8 @@ class Autotuner:
                 self._stale_coords += 1
                 self._coord ^= 1
                 if self._stale_coords >= 2:
-                    if not self._confirmed:
+                    if not self._confirmed and self._confirm_budget > 0:
+                        self._confirm_budget -= 1
                         # Confirmation revisit: score the incumbent a second
                         # time and AVERAGE with its earlier sample(s) (see
                         # _close_window) before pinning it, so a single
