@@ -374,9 +374,20 @@ def broadcast(
     """Every rank receives ``root_rank``'s value of ``tensor``.
 
     Reference semantics: tensorflow/mpi_ops.cc:393-463.  Lowered as a
-    masked ``psum`` — ``where(rank == root, x, 0)`` then all-reduce — which
-    XLA pattern-matches into an efficient ICI broadcast.  Works for every
-    dtype (bool/int via bitcast-free select on zeros).
+    masked ``psum`` — ``where(rank == root, x, 0)`` then ONE all-reduce.
+
+    Wire cost, honestly stated: a ring all-reduce moves ``2(n-1)/n ×
+    bytes`` per ICI link — a constant ≤2× over the optimal pipelined ring
+    broadcast's ``(n-1)/n × bytes``, INDEPENDENT of n.  This is the
+    deliberate TPU-first choice over the reference's MPI tree bcast
+    (operations.cc:1403-1407): the alternatives expressible in XLA today
+    are strictly worse at scale — a one-to-many ``collective-permute``
+    concentrates ``(n-1) × bytes`` on the root's own links (linear in n),
+    and ``all_gather``+index materializes and moves ``n ×`` the tensor.
+    XLA may further simplify the masked all-reduce; we do not rely on it.
+    The single-collective shape (no gather blowup, no one-to-many permute)
+    is pinned by ``tests/test_spmd_ops.py::test_broadcast_lowering``.
+    Works for every dtype (bool/int via bitcast-free select on zeros).
 
     With ``process_set``, ``root_rank`` must be a member; member ranks
     receive the root's value, non-members their own input.

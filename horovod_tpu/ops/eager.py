@@ -75,6 +75,13 @@ def _per_rank_nbytes(stacked: jax.Array) -> int:
     return (int(stacked.size) // max(n, 1)) * stacked.dtype.itemsize
 
 
+def _op_end_args(p: _PendingOp) -> dict:
+    """dtype/per-rank shape for an op END event (reference
+    timeline.cc:170-188 attaches them via TensorShape::DebugString), making
+    each trace track diagnosable without cross-referencing code."""
+    return {"dtype": str(p.tensor.dtype), "shape": list(p.tensor.shape[1:])}
+
+
 class EagerEngine:
     """Background engine: queue → cycle tick → fuse → dispatch.
 
@@ -555,10 +562,14 @@ class EagerEngine:
         """Dispatch one fused bucket; returns the last output array (for
         the autotuner's completion probe) or None on error."""
         names = [p.name for p in group]
-        if self.timeline:
+        # Snapshot: start_timeline() may attach a timeline while we're in
+        # the try block, and emitting E events whose B never happened would
+        # break the trace's B/E balance.
+        tl = self.timeline
+        if tl:
             for n in names:
-                self.timeline.start(n, "ALLREDUCE", {"fused_with": len(group) - 1})
-                self.timeline.start(n, timeline_mod.DISPATCH)
+                tl.start(n, "ALLREDUCE", {"fused_with": len(group) - 1})
+                tl.start(n, timeline_mod.DISPATCH)
         try:
             ps = group[0].process_set
             fn = self._allreduce_group_fn(group[0].op, group[0].compression, ps)
@@ -572,14 +583,15 @@ class EagerEngine:
                 self.handles.mark_error(p.handle, e)
             return None
         finally:
-            if self.timeline:
-                for n in names:
-                    self.timeline.end(n, timeline_mod.DISPATCH)
-                    self.timeline.end(n, "ALLREDUCE")
+            if tl:
+                for n, p in zip(names, group):
+                    tl.end(n, timeline_mod.DISPATCH)
+                    tl.end(n, "ALLREDUCE", _op_end_args(p))
 
     def _dispatch_single(self, p: _PendingOp) -> None:
-        if self.timeline:
-            self.timeline.start(p.name, p.kind.upper())
+        tl = self.timeline   # snapshot; see _dispatch_allreduce_group
+        if tl:
+            tl.start(p.name, p.kind.upper())
         try:
             if p.kind == "broadcast":
                 ps = p.process_set
@@ -673,8 +685,8 @@ class EagerEngine:
         except Exception as e:
             self.handles.mark_error(p.handle, e)
         finally:
-            if self.timeline:
-                self.timeline.end(p.name, p.kind.upper())
+            if tl:
+                tl.end(p.name, p.kind.upper(), _op_end_args(p))
 
 
 # ---------------------------------------------------------------------------

@@ -278,3 +278,76 @@ def test_start_stop_timeline_mid_run(tmp_path):
     }
     assert "inside.rec" in tracked
     assert "before.rec" not in tracked and "after.rec" not in tracked
+
+
+def test_timeline_schema_end_to_end(tmp_path, monkeypatch):
+    """Drive real ops through the engine with a timeline attached, then
+    validate the emitted file against the Chrome-trace event schema
+    (docs/timeline.md; reference timeline.cc:24-188) — and the reference's
+    end-event arg parity: every op END carries dtype/shape
+    (timeline.cc:170-188 attaches them via TensorShape::DebugString)."""
+    import json
+
+    import horovod_tpu as hvd
+
+    path = tmp_path / "tl_schema.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    hvd.shutdown()
+    hvd.init()
+    try:
+        x = hvd.per_rank(lambda r: jnp.full((2, 3), float(r)))
+        h = hvd.allreduce_async(x, name="tls.grad")
+        hvd.synchronize(h)
+        hvd.allgather(hvd.per_rank(lambda r: jnp.ones((2,), jnp.int32) * r),
+                      name="tls.gather")
+        hvd.broadcast(x, root_rank=1, name="tls.bcast")
+    finally:
+        hvd.shutdown()
+        monkeypatch.delenv("HOROVOD_TIMELINE")
+        hvd.init()
+
+    events = json.loads(path.read_text())
+    assert isinstance(events, list) and events
+
+    # -- Chrome-trace schema: known phases, required fields per phase.
+    for e in events:
+        assert isinstance(e["name"], str) and "ph" in e, e
+        assert e["ph"] in {"B", "E", "X", "M", "b", "e", "i"}, e
+        if e["ph"] != "M":
+            assert isinstance(e.get("ts", e.get("args")), (int, float, dict))
+        if e["ph"] in {"B", "E", "X", "b", "e"}:
+            assert isinstance(e["pid"], int) and "ts" in e, e
+        if e["ph"] in {"b", "e"}:
+            assert "id" in e and "cat" in e, e
+
+    # -- B/E balance per (pid, name): every span closes, LIFO per track.
+    open_spans: dict = {}
+    for e in events:
+        if e["ph"] == "B":
+            open_spans.setdefault((e["pid"], e["name"]), 0)
+            open_spans[(e["pid"], e["name"])] += 1
+        elif e["ph"] == "E":
+            key = (e["pid"], e["name"])
+            assert open_spans.get(key, 0) > 0, f"E without B: {e}"
+            open_spans[key] -= 1
+    assert all(v == 0 for v in open_spans.values()), open_spans
+
+    # -- Async spans matched by id.
+    for ph in ("b", "e"):
+        ids = [e["id"] for e in events if e["ph"] == ph]
+        assert len(ids) == len(set(ids))
+    assert ([e["id"] for e in events if e["ph"] == "b"]
+            == [e["id"] for e in events if e["ph"] == "e"])
+
+    # -- Reference arg parity: op END events carry dtype + per-rank shape.
+    for op, shape in (("ALLREDUCE", [2, 3]), ("ALLGATHER", [2]),
+                      ("BROADCAST", [2, 3])):
+        ends = [e for e in events if e["name"] == op and e["ph"] == "E"]
+        assert ends, f"no {op} end event"
+        for e in ends:
+            assert "dtype" in e["args"] and e["args"]["shape"] == shape, e
+
+    # -- Tensor-as-pid: each op name got its own pid + metadata row.
+    pids = {e["args"]["name"]: e["pid"] for e in events
+            if e["name"] == "process_name"}
+    assert {"tls.grad", "tls.gather", "tls.bcast"} <= set(pids)

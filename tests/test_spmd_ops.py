@@ -166,3 +166,28 @@ def test_hierarchical_allreduce_two_axis_mesh():
         jax.shard_map(fn, mesh=mesh2, in_specs=P("dcn", "ici"), out_specs=P())
     )
     np.testing.assert_allclose(float(f(x)), 28.0)
+
+
+def test_broadcast_lowering():
+    """Pin the broadcast wire shape: exactly ONE all-reduce collective,
+    no all_gather blowup, no one-to-many collective-permute.  Rationale
+    and cost analysis: the ops.broadcast docstring."""
+    x = _rank_major(lambda r: jnp.full((128,), float(r)))
+    f = _smap(lambda a: ops.broadcast(a[0], 3))
+    stablehlo = f.lower(x).as_text()
+    assert stablehlo.count("all_reduce") == 1, stablehlo
+    for banned in ("all_gather", "all_to_all", "collective_permute",
+                   "collective_broadcast"):
+        assert banned not in stablehlo, f"broadcast lowered through {banned}"
+
+
+def test_broadcast_process_set_lowering_single_allreduce():
+    """The process-set form must keep the single-collective shape too."""
+    from horovod_tpu import ProcessSet
+
+    ps = ProcessSet([1, 3, 5, 7])
+    x = _rank_major(lambda r: jnp.full((16,), float(r)))
+    f = _smap(lambda a: ops.broadcast(a[0], 3, process_set=ps))
+    stablehlo = f.lower(x).as_text()
+    assert stablehlo.count("all_reduce") == 1, stablehlo
+    assert "all_gather" not in stablehlo
