@@ -126,6 +126,14 @@ class EagerEngine:
         self._flush_lock = threading.Lock()
         self._queue: list[_PendingOp] = []
         self._dispatch_cache: dict[tuple, Any] = {}
+        # CPU-simulation only (same rationale as make_train_step's
+        # throttle): XLA CPU collectives are matched by arrival order on
+        # shared in-process/Gloo transport, so multiple collective launches
+        # in flight can execute in different orders on different ranks and
+        # pair mismatched messages ("received data size doesn't match").
+        # Blocking per dispatch caps in-flight depth at 1; TPU's ordered
+        # stream needs no throttle and keeps the async pipeline.
+        self._serialize_dispatch = jax.default_backend() == "cpu"
         self._shutdown = threading.Event()
         self._tick = threading.Event()
         self.controller = self._maybe_native_controller(cfg)
@@ -574,6 +582,8 @@ class EagerEngine:
             ps = group[0].process_set
             fn = self._allreduce_group_fn(group[0].op, group[0].compression, ps)
             outs = fn(tuple(p.tensor.reshape(p.tensor.shape[0], -1) for p in group))
+            if self._serialize_dispatch:
+                jax.block_until_ready(outs)
             for p, out in zip(group, outs):
                 shape = p.tensor.shape if ps is not None else p.tensor.shape[1:]
                 self.handles.mark_dispatched(p.handle, out.reshape(shape))
@@ -587,6 +597,11 @@ class EagerEngine:
                 for n, p in zip(names, group):
                     tl.end(n, timeline_mod.DISPATCH)
                     tl.end(n, "ALLREDUCE", _op_end_args(p))
+
+    def _mark_single(self, p: _PendingOp, out) -> None:
+        if self._serialize_dispatch:
+            jax.block_until_ready(out)
+        self.handles.mark_dispatched(p.handle, out)
 
     def _dispatch_single(self, p: _PendingOp) -> None:
         tl = self.timeline   # snapshot; see _dispatch_allreduce_group
@@ -616,7 +631,7 @@ class EagerEngine:
                         bc, out_specs=P(self._axis) if ps is not None else P()
                     )
                     self._dispatch_cache[key] = fn
-                self.handles.mark_dispatched(p.handle, fn(p.tensor))
+                self._mark_single(p, fn(p.tensor))
             elif p.kind == "allgather":
                 fn = self._dispatch_cache.get("ag")
                 if fn is None:
@@ -646,7 +661,7 @@ class EagerEngine:
                         ],
                         axis=0,
                     )
-                self.handles.mark_dispatched(p.handle, gathered)
+                self._mark_single(p, gathered)
             elif p.kind == "alltoall":
                 fn = self._dispatch_cache.get("a2a")
                 if fn is None:
@@ -664,7 +679,7 @@ class EagerEngine:
 
                     fn = self._shard_map(a2a, out_specs=P(self._axis))
                     self._dispatch_cache["a2a"] = fn
-                self.handles.mark_dispatched(p.handle, fn(p.tensor))
+                self._mark_single(p, fn(p.tensor))
             elif p.kind == "sparse":
                 topk = p.topk
                 key = ("sp", topk.ratio, topk.k, p.op.name)
@@ -679,7 +694,7 @@ class EagerEngine:
 
                     fn = self._shard_map(sp)
                     self._dispatch_cache[key] = fn
-                self.handles.mark_dispatched(p.handle, fn(p.tensor))
+                self._mark_single(p, fn(p.tensor))
             else:  # pragma: no cover
                 raise ValueError(f"unknown op kind {p.kind}")
         except Exception as e:
@@ -957,6 +972,12 @@ def set_handle_post(handle: int, payload) -> None:
 def take_handle_post(handle: int):
     """Detach the handle's post payload; None if absent/released."""
     return _engine().handles.take_post(handle)
+
+
+def update_handle_post(handle: int, **items) -> None:
+    """Merge keys into a dict post payload, atomically under the manager
+    lock."""
+    _engine().handles.update_post(handle, items)
 
 
 def release(handle: int) -> None:

@@ -61,6 +61,19 @@ class HandleManager:
             if e is not None:
                 e.post = payload
 
+    def update_post(self, handle: int, items: dict) -> None:
+        """Merge keys into a dict-valued post payload — one atomic
+        read-modify-write under the manager lock (a take/set pair would
+        race a concurrent release and resurrect the payload on a dead or
+        recycled handle)."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                return
+            if not isinstance(e.post, dict):
+                e.post = {}
+            e.post.update(items)
+
     def take_post(self, handle: int) -> Any:
         """Detach and return the handle's post payload (None if absent)."""
         with self._lock:

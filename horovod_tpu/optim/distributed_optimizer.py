@@ -336,34 +336,75 @@ def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
     return jax.tree.unflatten(treedef, restored)
 
 
+def _mesh_local_rows() -> int:
+    """How many rows of the rank-major array this process owns — counted
+    on the WORLD MESH, not jax.local_device_count(): a device-subset init
+    may exclude some local devices from the mesh."""
+    me = jax.process_index()
+    return sum(
+        1 for d in basics.mesh().devices.flat if d.process_index == me
+    )
+
+
+def _process_first_rows() -> dict[int, int]:
+    """process index → first global rank (mesh device-order row) owned by
+    that process.  Consults the actual mesh device order, like
+    ``_root_process`` — mesh order is NOT guaranteed process-contiguous."""
+    first: dict[int, int] = {}
+    for r, d in enumerate(basics.mesh().devices.flat):
+        first.setdefault(d.process_index, r)
+    return first
+
+
+def _process_rank_major(local) -> jax.Array:
+    """This process's payload, tiled to its local device rows of the global
+    rank-major array (every local device carries the same bytes)."""
+    import numpy as np
+
+    rows = np.broadcast_to(local[None], (_mesh_local_rows(),) + local.shape)
+    return jax.make_array_from_process_local_data(basics.rank_sharding(), rows)
+
+
 def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     """Broadcast an arbitrary picklable object (the resume-epoch pattern of
     reference examples/keras_imagenet_resnet50.py:66-73).
 
     ``root_rank`` is a device rank; the object travels from the process
     that owns that device (any root works, like ``broadcast_parameters``).
+
+    The wire goes THROUGH the eager engine, not an out-of-band host
+    collective: multi-process XLA collectives are matched by arrival order
+    on shared transport pairs, so an out-of-band broadcast racing the
+    engine's cycle-thread dispatches can pair with the WRONG collective on
+    a peer still draining engine traffic ("received data size doesn't
+    match expected size").  Enqueueing serializes it with every queued
+    engine op — the same reasoning as the torch frontend's
+    shape negotiation (torch.py _negotiate_gather_shapes).
     """
     basics._require_init()
     if jax.process_count() == 1:
         return obj
     import pickle
 
-    from jax.experimental import multihost_utils
+    import numpy as np
+
+    from horovod_tpu.ops import eager as eager_ops
 
     is_source = basics.cross_rank() == _root_process(root_rank)
-    if is_source:
-        payload = jnp.frombuffer(pickle.dumps(obj), dtype=jnp.uint8)
-        length = jnp.asarray([payload.size], jnp.int32)
-    else:
-        payload = jnp.zeros((0,), jnp.uint8)
-        length = jnp.asarray([0], jnp.int32)
-    n = int(
-        multihost_utils.broadcast_one_to_all(length, is_source=is_source)[0]
+    payload = (np.frombuffer(pickle.dumps(obj), np.uint8) if is_source
+               else np.zeros((0,), np.uint8))
+    length = np.asarray([payload.size], np.int32)
+    h = eager_ops.broadcast_async(
+        _process_rank_major(length), root_rank, name="bo.len"
     )
+    n = int(np.asarray(jax.device_get(eager_ops.synchronize(h)))[0])
     if not is_source:
-        payload = jnp.zeros((n,), jnp.uint8)
-    data = multihost_utils.broadcast_one_to_all(payload, is_source=is_source)
-    return pickle.loads(bytes(bytearray(jax.device_get(data))))
+        payload = np.zeros((n,), np.uint8)
+    h = eager_ops.broadcast_async(
+        _process_rank_major(payload), root_rank, name="bo.payload"
+    )
+    data = jax.device_get(eager_ops.synchronize(h))
+    return pickle.loads(bytes(bytearray(np.asarray(data))))
 
 
 def allgather_object(obj: Any) -> list:
@@ -373,24 +414,38 @@ def allgather_object(obj: Any) -> list:
     The object-level sibling of the eager ``allgather`` (an API later
     Horovod versions grew; natural here for gathering per-host metrics or
     shapes).  Wire format: lengths all-gather, pad to max, bytes
-    all-gather, unpickle.
+    all-gather, unpickle — all THROUGH the engine queue (see
+    :func:`broadcast_object` for why out-of-band host collectives are a
+    cross-rank ordering hazard).
     """
     basics._require_init()
     if jax.process_count() == 1:
         return [obj]
     import pickle
 
-    from jax.experimental import multihost_utils
+    import numpy as np
+
+    from horovod_tpu.ops import eager as eager_ops
 
     payload = pickle.dumps(obj)
-    lengths = multihost_utils.process_allgather(
-        jnp.asarray([len(payload)], jnp.int32)
-    ).reshape(-1)                                       # [P]
+    h = eager_ops.allgather_async(
+        _process_rank_major(np.asarray([[len(payload)]], np.int32)),
+        name="ao.len",
+    )
+    lengths = np.asarray(
+        jax.device_get(eager_ops.synchronize(h))
+    ).reshape(-1)                                       # [size] (per device)
     pad = int(lengths.max())
-    buf = jnp.frombuffer(payload.ljust(pad, b"\0"), dtype=jnp.uint8)
-    data = multihost_utils.process_allgather(buf)       # [P, pad]
-    out = []
-    for p in range(int(lengths.shape[0])):
-        raw = bytes(bytearray(jax.device_get(data[p])))[: int(lengths[p])]
-        out.append(pickle.loads(raw))
-    return out
+    buf = np.frombuffer(payload.ljust(pad, b"\0"), np.uint8)
+    h = eager_ops.allgather_async(
+        _process_rank_major(buf[None]), name="ao.payload"
+    )
+    data = np.asarray(
+        jax.device_get(eager_ops.synchronize(h))
+    ).reshape(-1, pad)                                  # [size, pad]
+    # One row per participating process, in process-index order, located
+    # through the mesh's actual device order (not an assumed contiguity).
+    return [
+        pickle.loads(bytes(bytearray(data[r]))[: int(lengths[r])])
+        for _, r in sorted(_process_first_rows().items())
+    ]

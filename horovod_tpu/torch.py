@@ -72,7 +72,22 @@ def _to_rank_major(t) -> Any:
     """This process's torch tensor → its row of the rank-major array."""
     import jax
 
-    local = np.ascontiguousarray(t.detach().cpu().numpy())
+    # ascontiguousarray promotes 0-dim to 1-dim; reshape restores the true
+    # shape so scalars (e.g. BatchNorm's num_batches_tracked in a
+    # state_dict broadcast) don't grow a bogus axis.
+    local = np.ascontiguousarray(t.detach().cpu().numpy()).reshape(
+        tuple(t.shape)
+    )
+    if local.dtype == np.int64:
+        # The wire is int32 (jax x64 is off); a silently wrapped value
+        # would corrupt the collective, so reject out-of-range up front.
+        if local.size and (local.max() > 0x7FFFFFFF
+                           or local.min() < -0x80000000):
+            raise ValueError(
+                "int64 tensor holds values outside int32 range; the TPU "
+                "wire carries int32 (enable smaller dtypes or split the "
+                "value)"
+            )
     if _basics.size() == 1:
         return jax.device_put(local[None], _basics.rank_sharding())
     return jax.make_array_from_process_local_data(
@@ -90,13 +105,35 @@ def _to_torch(arr) -> Any:
 # ---------------------------------------------------------------------- ops
 
 
+def _attach_post(handle: int, **kv) -> None:
+    """Merge keys into the handle's post payload (a dict living in the
+    HandleManager entry — one atomic update under the manager lock,
+    released with the handle)."""
+    _eager.update_handle_post(handle, **kv)
+
+
+def _note_wire_dtype(handle: int, tensor) -> int:
+    """The XLA wire narrows int64→int32 / float64→float32 (x64 off);
+    remember the caller's dtype so ``synchronize`` hands back a tensor of
+    the dtype it was given.  int64 INPUTS are validated to fit int32
+    (``_to_rank_major``), so broadcast/gather round-trip exactly; a Sum
+    allreduce can still overflow the 32-bit wire across ranks, as it
+    would any fixed-width wire.  float64 rides at float32 precision —
+    the same loss ``Compression.fp16`` users already opt into."""
+    torch = _torch()
+    if tensor.dtype in (torch.int64, torch.float64):
+        _attach_post(handle, dtype=tensor.dtype)
+    return handle
+
+
 def allreduce_async(tensor, average=True, name=None, *, op=None,
                     compression=Compression.none) -> int:
     if op is None:
         op = Average if average else Sum
-    return _eager.allreduce_async(
+    h = _eager.allreduce_async(
         _to_rank_major(tensor), name=name, op=op, compression=compression
     )
+    return _note_wire_dtype(h, tensor)
 
 
 def allreduce(tensor, average=True, name=None, *, op=None,
@@ -193,8 +230,8 @@ def allgather_async(tensor, name=None) -> int:
         local = padded
     h = _eager.allgather_async(_to_rank_major(local), name=name)
     if len(set(sizes)) > 1:
-        _eager.set_handle_post(h, ("ragged", (pad, sizes)))
-    return h
+        _attach_post(h, ragged=(pad, sizes))
+    return _note_wire_dtype(h, tensor)
 
 
 def allgather(tensor, name=None):
@@ -210,8 +247,8 @@ def alltoall_async(tensor, name=None) -> int:
     the whole array (which would fail on non-addressable multi-host
     shards) — flagged via the handle's post payload."""
     h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
-    _eager.set_handle_post(h, ("rank_major", None))
-    return h
+    _attach_post(h, rank_major=True)
+    return _note_wire_dtype(h, tensor)
 
 
 def alltoall(tensor, name=None):
@@ -219,8 +256,8 @@ def alltoall(tensor, name=None):
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
-    return _eager.broadcast_async(_to_rank_major(tensor), root_rank,
-                                  name=name)
+    h = _eager.broadcast_async(_to_rank_major(tensor), root_rank, name=name)
+    return _note_wire_dtype(h, tensor)
 
 
 def broadcast(tensor, root_rank, name=None):
@@ -238,9 +275,10 @@ def sparse_allreduce_async(tensor, name=None, *, average: bool = False,
     """The fork's top-k sparse allreduce on torch tensors (reference
     horovod/torch/__init__.py:46-83: mpi4py Allgatherv of nonzero
     values+indices; here top_k → allgather → scatter-add, compiled)."""
-    return _eager.sparse_allreduce_async(
+    h = _eager.sparse_allreduce_async(
         _to_rank_major(tensor), name=name, average=average, ratio=ratio, k=k
     )
+    return _note_wire_dtype(h, tensor)
 
 
 def sparse_allreduce(tensor, name=None, *, average: bool = False,
@@ -272,19 +310,24 @@ def synchronize(handle: int):
     # Detach the post payload BEFORE waiting: if the wait raises, the
     # payload is already off the entry and the entry itself is released by
     # the manager's error path — nothing to leak either way.
-    post = _eager.take_handle_post(handle)
+    post = _eager.take_handle_post(handle) or {}
     raw = _eager.synchronize(handle)
-    if post is not None and post[0] == "rank_major":
-        torch = _torch()
+    torch = _torch()
+    if post.get("rank_major"):
         local = np.asarray(raw.addressable_shards[0].data)[0]
-        return torch.from_numpy(np.array(local))
-    out = _to_torch(raw)
-    if post is not None and post[0] == "ragged":
-        torch = _torch()
-        pad, sizes = post[1]
-        out = torch.cat(
-            [out[r * pad:r * pad + s] for r, s in enumerate(sizes)], dim=0
-        )
+        out = torch.from_numpy(np.array(local))
+    else:
+        out = _to_torch(raw)
+        rag = post.get("ragged")
+        if rag is not None:
+            pad, sizes = rag
+            out = torch.cat(
+                [out[r * pad:r * pad + s] for r, s in enumerate(sizes)],
+                dim=0,
+            )
+    want = post.get("dtype")
+    if want is not None and out.dtype != want:
+        out = out.to(want)
     return out
 
 

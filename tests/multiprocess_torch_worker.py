@@ -222,6 +222,19 @@ def main() -> None:
         "ranks diverged under disjoint-grad force-allreduce"
     )
 
+    # --- Scalar + int64 round-trip: a state_dict broadcast carries 0-dim
+    # LongTensors (BatchNorm num_batches_tracked); shape AND dtype must
+    # survive the int32 wire (regression: ascontiguousarray 0-dim
+    # promotion gave them a bogus [1] axis).
+    s = torch.tensor(41 + me)                       # 0-dim int64
+    sb = hvd.broadcast(s, 0, name="t.scalar")
+    assert sb.shape == () and sb.dtype == torch.int64 and int(sb) == 41, sb
+    try:
+        hvd.broadcast(torch.tensor(2 ** 40), 0, name="t.overflow")
+        raise AssertionError("int64 overflow should be rejected")
+    except ValueError as e:
+        assert "int32" in str(e)
+
     hvd.shutdown()
     print("TORCH_OK " + json.dumps({"rank": me, "size": n}), flush=True)
 

@@ -340,3 +340,39 @@ def test_pytorch_synthetic_benchmark_via_launcher():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "Total img/sec on 2 worker(s):" in r.stdout
+
+
+@pytest.mark.slow
+def test_pytorch_imagenet_resume_after_crash(tmp_path):
+    """The reference's canonical fault-recovery recipe end-to-end
+    (reference examples/pytorch_imagenet_resnet50.py:62-75,134-142):
+    launch 1 saves epoch-1's checkpoint on rank 0 then dies abruptly
+    (os._exit mid-gang); launch 2 finds the checkpoint, broadcasts
+    resume_from_epoch, loads on rank 0, broadcast_parameters +
+    broadcast_optimizer_state, and finishes the remaining epoch."""
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    script = os.path.join(os.path.dirname(HERE), "examples",
+                          "pytorch_imagenet_resnet50.py")
+    ckpt_dir = str(tmp_path / "ckpts")
+    base = [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+            "--cpu", "--", sys.executable, script, "--smoke",
+            "--checkpoint-dir", ckpt_dir]
+
+    r1 = subprocess.run(base + ["--crash-after", "1"], env=env,
+                        capture_output=True, text=True, timeout=300,
+                        cwd=os.path.dirname(HERE))
+    assert r1.returncode != 0, "crash injection should fail the gang"
+    assert "CRASH-INJECTED after epoch 1" in r1.stdout, r1.stdout + r1.stderr
+    assert os.path.exists(os.path.join(ckpt_dir, "checkpoint-1.pt"))
+
+    r2 = subprocess.run(base, env=env, capture_output=True, text=True,
+                        timeout=300, cwd=os.path.dirname(HERE))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resumed_from 1" in r2.stdout, r2.stdout
+    # Only the post-resume epoch ran in launch 2.
+    assert "epoch 2:" in r2.stdout and "epoch 1:" not in r2.stdout
+    assert os.path.exists(os.path.join(ckpt_dir, "checkpoint-2.pt"))
