@@ -64,10 +64,17 @@ class Autotuner:
 
     def __init__(self, config, *, warmup_samples: int = 3,
                  window_flushes: int = 10, min_window_bytes: int = 1 * MiB,
-                 log_path: str | None = None):
+                 log_path: str | None = None, on_move=None):
+        """``on_move(threshold_bytes, cycle_ms)`` fires on every setting
+        move (including the final pin) — the control-plane hook: with the
+        native controller, rank 0's engine forwards it to
+        ``NativeController.set_tuned`` so the whole gang re-buckets at the
+        next tick and every rank observes the move via the response
+        piggyback."""
         import threading
 
         self.config = config
+        self.on_move = on_move
         self.warmup_samples = warmup_samples
         self.warmup_left = warmup_samples
         self.window_flushes = window_flushes
@@ -199,6 +206,8 @@ class Autotuner:
         self._pos = pos
         self.config.fusion_threshold_bytes = THRESHOLD_GRID[pos[0]]
         self.config.cycle_time_ms = CYCLE_GRID_MS[pos[1]]
+        if self.on_move is not None:
+            self.on_move(THRESHOLD_GRID[pos[0]], CYCLE_GRID_MS[pos[1]])
         # A new threshold changes bucket shapes → the next flushes pay XLA
         # compilation.  Each grid point is scored exactly once, so letting
         # compile time into its one window would permanently penalize every

@@ -135,6 +135,9 @@ def load_library() -> ctypes.CDLL:
         lib.hvdtpu_controller_enable_tick_trace.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
         ]
+        lib.hvdtpu_controller_set_tuned.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double,
+        ]
         lib.hvdtpu_controller_drain_ticks.restype = ctypes.c_int
         lib.hvdtpu_controller_drain_ticks.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
@@ -164,6 +167,10 @@ class Batch:
 class BatchList:
     shutdown: bool
     batches: list[Batch] = field(default_factory=list)
+    # Rank-0-tuned knobs piggybacked on the response (None = unset); every
+    # rank observes a move in the same tick (control-plane autotune).
+    tuned_threshold_bytes: int | None = None
+    tuned_cycle_ms: float | None = None
 
 
 def _parse_batch_list(data: bytes) -> BatchList:
@@ -182,6 +189,12 @@ def _parse_batch_list(data: bytes) -> BatchList:
         off += 4
         return v
 
+    def i64():
+        nonlocal off
+        (v,) = struct.unpack_from("<q", data, off)
+        off += 8
+        return v
+
     def s():
         n = u32()
         nonlocal off
@@ -190,13 +203,19 @@ def _parse_batch_list(data: bytes) -> BatchList:
         return v
 
     shutdown = u8() != 0
+    thr = i64()
+    cyc_us = i64()
     batches = []
     for _ in range(u32()):
         kind = u8()
         error = s()
         names = [s() for _ in range(u32())]
         batches.append(Batch(kind, error, names))
-    return BatchList(shutdown, batches)
+    return BatchList(
+        shutdown, batches,
+        tuned_threshold_bytes=thr if thr >= 0 else None,
+        tuned_cycle_ms=cyc_us / 1000.0 if cyc_us >= 0 else None,
+    )
 
 
 class NativeController:
@@ -260,6 +279,18 @@ class NativeController:
             return ctypes.string_at(out, n.value).decode()
         finally:
             self._lib.hvdtpu_free(out)
+
+    def set_tuned(self, threshold_bytes: int = -1,
+                  cycle_ms: float = -1.0) -> None:
+        """Install rank-0-tuned knobs (control-plane autotune).  Fusion
+        batching is decided only by rank 0's controller, so a threshold set
+        here governs the whole gang from the next tick; both values ride
+        every response so all ranks observe the move together.  Negative =
+        leave that knob unchanged; no-op off rank 0."""
+        if self._ptr:
+            self._lib.hvdtpu_controller_set_tuned(
+                self._ptr, int(threshold_bytes), float(cycle_ms)
+            )
 
     def enable_tick_trace(self, on: bool = True) -> None:
         """Record per-rank request arrivals on rank 0 (timeline NEGOTIATE

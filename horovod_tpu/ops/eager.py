@@ -144,21 +144,41 @@ class EagerEngine:
         self._submitted: dict[str, _PendingOp] = {}
         self.autotuner = None
         if cfg.autotune:
-            if self.controller is not None or jax.process_count() > 1:
-                # Two reasons to refuse: (a) the native controller's fusion
-                # threshold is fixed at construction and rank 0 owns fusion
-                # decisions for every rank — local mutation would be a lie;
-                # (b) in a multi-controller job WITHOUT the controller,
-                # per-host tuners scored on host-local noise would move to
-                # different thresholds at different times, split the same
-                # group into different buckets per host, and deadlock the
+            if self.controller is not None:
+                # Control-plane autotune: rank 0 OWNS the tuner (it owns
+                # batching — BuildBatches runs only there), and every move
+                # is installed into the native controller, which applies it
+                # to the next tick's bucketing and piggybacks the values on
+                # the response so all ranks observe the move in the same
+                # tick (reference-shaped: rank-0 tunes, renegotiates
+                # through the control plane).
+                if jax.process_index() == 0:
+                    from horovod_tpu.autotune import Autotuner
+
+                    self.autotuner = Autotuner(
+                        cfg,
+                        warmup_samples=cfg.autotune_warmup_samples,
+                        window_flushes=cfg.autotune_steady_state_samples,
+                        log_path=cfg.autotune_log,
+                        on_move=self.controller.set_tuned,
+                    )
+                    # No init-time SetTuned: the controller already holds
+                    # the construction threshold, and pre-seeding would mark
+                    # untouched defaults as "tuned", silently overriding any
+                    # per-rank env differences before the first real move.
+            elif jax.process_count() > 1:
+                # Multi-controller WITHOUT the native controller: per-host
+                # tuners scored on host-local noise would move to different
+                # thresholds at different times, split the same group into
+                # different buckets per host, and deadlock the
                 # differently-fused collectives (see _fuse_key).
                 print(
-                    "WARNING: HOROVOD_AUTOTUNE=1 ignored: autotuning "
-                    "applies to single-process Python-coordinated engines "
-                    "only (native-controller fusion is fixed at startup; "
-                    "independent per-host tuning would diverge bucket "
-                    "plans across hosts).",
+                    "WARNING: HOROVOD_AUTOTUNE=1 ignored: multi-host "
+                    "autotuning requires the native controller "
+                    "(HOROVOD_TPU_NATIVE_CONTROLLER=on), where rank 0 "
+                    "tunes and renegotiates the threshold through the "
+                    "control plane; independent per-host tuning would "
+                    "diverge bucket plans across hosts.",
                     file=sys.stderr,
                 )
             else:
@@ -301,40 +321,41 @@ class EagerEngine:
             with self._lock:
                 batch, self._queue = self._queue, []
             if self.controller is not None:
-                self._flush_via_controller(batch)
-                return
-            if not batch:
-                return
-            for p in batch:
-                if self.timeline:
-                    self.timeline.end(
-                        p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
-                    )
-            buckets = fusion.plan_buckets(
-                batch,
-                self.config.fusion_threshold_bytes,
-                nbytes=lambda p: _per_rank_nbytes(p.tensor),
-                key=self._fuse_key,
-            )
-            ar_bytes, sample_out = 0, None
-            for bucket in buckets:
-                group = [batch[i] for i in bucket]
-                if group[0].kind == "allreduce":
-                    out = self._dispatch_allreduce_group(group)
-                    if out is not None:
-                        ar_bytes += sum(
-                            _per_rank_nbytes(p.tensor) for p in group
+                # Controller path: the returned sample (rank 0 with
+                # autotune only) is its dispatched allreduce traffic.
+                tune_sample = self._flush_via_controller(batch)
+            elif batch:
+                for p in batch:
+                    if self.timeline:
+                        self.timeline.end(
+                            p.name,
+                            timeline_mod.NEGOTIATE + "_" + p.kind.upper(),
                         )
-                        sample_out = out
-                else:
-                    assert len(group) == 1
-                    self._dispatch_single(group[0])
-            if self.autotuner is not None and ar_bytes:
-                tune_sample = (ar_bytes, sample_out)
+                buckets = fusion.plan_buckets(
+                    batch,
+                    self.config.fusion_threshold_bytes,
+                    nbytes=lambda p: _per_rank_nbytes(p.tensor),
+                    key=self._fuse_key,
+                )
+                ar_bytes, sample_out = 0, None
+                for bucket in buckets:
+                    group = [batch[i] for i in bucket]
+                    if group[0].kind == "allreduce":
+                        out = self._dispatch_allreduce_group(group)
+                        if out is not None:
+                            ar_bytes += sum(
+                                _per_rank_nbytes(p.tensor) for p in group
+                            )
+                            sample_out = out
+                    else:
+                        assert len(group) == 1
+                        self._dispatch_single(group[0])
+                if self.autotuner is not None and ar_bytes:
+                    tune_sample = (ar_bytes, sample_out)
         # Score OUTSIDE the flush lock: closing a window blocks on device
         # completion of the probe, and holding the lock through that would
         # stall every concurrent synchronize()/poll() flush.
-        if tune_sample is not None:
+        if tune_sample is not None and self.autotuner is not None:
             self.autotuner.observe(*tune_sample)
 
     _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
@@ -367,9 +388,13 @@ class EagerEngine:
 
         return int.from_bytes(hashlib.sha1(token).digest()[:7], "big")
 
-    def _flush_via_controller(self, batch: list[_PendingOp]) -> None:
+    def _flush_via_controller(self, batch: list[_PendingOp]):
         """Submit new requests, run one negotiation tick, dispatch the
-        globally-agreed batches (names → this process's pending ops)."""
+        globally-agreed batches (names → this process's pending ops).
+
+        Returns ``(allreduce_bytes, sample_output)`` when this rank runs
+        the autotuner (rank 0) and the tick dispatched allreduce traffic;
+        None otherwise."""
         for p in batch:
             if p.name in self._submitted:
                 # The reference rejects duplicate in-flight names at enqueue
@@ -409,6 +434,18 @@ class EagerEngine:
         if self.timeline:
             for tname, trank in self.controller.drain_ticks():
                 self.timeline.instant(tname, f"NEGOTIATE_TICK_r{trank}")
+        # Control-plane autotune: apply rank-0's tuned knobs, piggybacked on
+        # every response, so the whole gang's config moves in the same tick
+        # (bucketing itself is already rank-0-owned via BuildBatches).  The
+        # tuner OWNER skips the apply: its tuner writes config directly in
+        # _move_to, and a response built just before a move landed would
+        # briefly roll its config back.
+        if self.autotuner is None:
+            if bl.tuned_threshold_bytes is not None:
+                self.config.fusion_threshold_bytes = bl.tuned_threshold_bytes
+            if bl.tuned_cycle_ms is not None:
+                self.config.cycle_time_ms = bl.tuned_cycle_ms
+        ar_bytes, sample_out = 0, None
         for b in bl.batches:
             ops = [
                 self._submitted.pop(n) for n in b.names if n in self._submitted
@@ -422,7 +459,10 @@ class EagerEngine:
                 for p in ops:
                     self.handles.mark_error(p.handle, err)
             elif ops[0].kind == "allreduce":
-                self._dispatch_allreduce_group(ops)
+                out = self._dispatch_allreduce_group(ops)
+                if out is not None:
+                    ar_bytes += sum(_per_rank_nbytes(p.tensor) for p in ops)
+                    sample_out = out
             else:
                 for p in ops:
                     self._dispatch_single(p)
@@ -439,6 +479,9 @@ class EagerEngine:
                 self.handles.mark_error(p.handle, err)
             self._submitted.clear()
             self._shutdown.set()
+        if self.autotuner is not None and ar_bytes:
+            return (ar_bytes, sample_out)
+        return None
 
     def _end_negotiate(self, p: _PendingOp) -> None:
         if self.timeline:

@@ -117,6 +117,15 @@ void hvdtpu_controller_enable_tick_trace(void* ctrl, int on) {
   static_cast<Controller*>(ctrl)->EnableTickTrace(on != 0);
 }
 
+// Control-plane autotune: install rank-0-tuned engine knobs (negative =
+// leave that knob unchanged).  No-op on non-root ranks and null handles.
+void hvdtpu_controller_set_tuned(void* ctrl, long long threshold_bytes,
+                                 double cycle_ms) {
+  if (!ctrl) return;
+  static_cast<Controller*>(ctrl)->SetTuned(
+      static_cast<int64_t>(threshold_bytes), cycle_ms);
+}
+
 // Drains rank-0's negotiation tick trace ("rank<SP>name\n" lines); empty on
 // other ranks or when tracing is disabled.  Free with hvdtpu_free.
 int hvdtpu_controller_drain_ticks(void* ctrl, uint8_t** out,

@@ -153,7 +153,7 @@ BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
       // compression) so the controller never merges incompatible programs.
       const bool same = !cur.names.empty() && cur_dtype == e.first.dtype &&
                         cur_group == e.first.group;
-      if (!same || cur_bytes + bytes > fusion_threshold_bytes_) flush();
+      if (!same || cur_bytes + bytes > EffectiveThreshold()) flush();
       cur.kind = OpKind::kAllreduce;
       cur_dtype = e.first.dtype;
       cur_group = e.first.group;
@@ -194,6 +194,8 @@ TickStatus Controller::Tick(BatchList* out) {
     }
     BatchList built = BuildBatches(ready);
     built.shutdown = shutdown_seen;
+    built.tuned_threshold_bytes = tuned_threshold_bytes_;
+    built.tuned_cycle_ms = tuned_cycle_ms_;
     response_bytes = wire::SerializeBatchList(built);
   }
   std::string received;
@@ -203,6 +205,13 @@ TickStatus Controller::Tick(BatchList* out) {
   *out = wire::ParseBatchList(rd);
   if (out->shutdown) shut_down_ = true;
   return out->shutdown ? TickStatus::kShutdown : TickStatus::kLive;
+}
+
+void Controller::SetTuned(int64_t threshold_bytes, double cycle_ms) {
+  if (rank_ != 0) return;  // rank 0 owns batching; see header comment
+  std::lock_guard<std::mutex> lk(table_mu_);
+  if (threshold_bytes >= 0) tuned_threshold_bytes_ = threshold_bytes;
+  if (cycle_ms >= 0) tuned_cycle_ms_ = cycle_ms;
 }
 
 void Controller::EnableTickTrace(bool on) {

@@ -61,6 +61,14 @@ class Controller {
   // than the warning threshold, with the missing ranks (empty if none).
   std::string StallReport();
 
+  // Control-plane autotune: rank 0's tuner installs new engine knobs here
+  // (thread-safe).  Fusion batching is decided ONLY by rank 0's
+  // BuildBatches, so the threshold takes effect for the whole gang at the
+  // next tick; both values are also piggybacked on every response so all
+  // ranks observe the move in the same tick (negative = leave unset).
+  // No-op on non-root ranks — their local value would be a lie.
+  void SetTuned(int64_t threshold_bytes, double cycle_ms);
+
   // Per-rank negotiation tick trace (reference timeline.cc:98-132 emits an
   // instant event on rank 0's timeline each time a rank's request for a
   // tensor arrives).  Off by default — recording without a consumer would
@@ -82,9 +90,18 @@ class Controller {
   void Ingest(const Request& r, std::vector<std::string>* ready);
   BatchList BuildBatches(const std::vector<std::string>& ready);
 
+  // Effective fusion threshold: the tuned value when set, else the
+  // construction-time one.  Called under table_mu_.
+  int64_t EffectiveThreshold() const {
+    return tuned_threshold_bytes_ >= 0 ? tuned_threshold_bytes_
+                                       : fusion_threshold_bytes_;
+  }
+
   const int rank_, size_;
   const int64_t fusion_threshold_bytes_;
   const double stall_warning_s_;
+  int64_t tuned_threshold_bytes_ = -1;  // guarded by table_mu_
+  double tuned_cycle_ms_ = -1.0;        // guarded by table_mu_
   std::unique_ptr<Transport> transport_;
 
   std::mutex pending_mu_;

@@ -101,6 +101,11 @@ struct Batch {
 struct BatchList {
   bool shutdown = false;
   std::vector<Batch> batches;
+  // Rank-0-owned tuned engine knobs, piggybacked on every response so the
+  // whole gang observes a move in the SAME tick (control-plane autotune).
+  // Negative = "no value"; receivers keep their current setting.
+  int64_t tuned_threshold_bytes = -1;
+  double tuned_cycle_ms = -1.0;
 };
 
 }  // namespace hvdtpu

@@ -10,6 +10,7 @@
 #ifndef HVDTPU_WIRE_H_
 #define HVDTPU_WIRE_H_
 
+#include <cmath>
 #include <cstring>
 #include <stdexcept>
 #include <string>
@@ -142,6 +143,11 @@ inline RequestList ParseRequestList(Reader& rd) {
 inline std::string SerializeBatchList(const BatchList& bl) {
   Writer w;
   w.U8(bl.shutdown ? 1 : 0);
+  w.I64(bl.tuned_threshold_bytes);
+  // Cycle time rides as micros in an i64: the wire stays integer-only.
+  // llround, not a truncating cast: N/1000.0*1000.0 can land just below N
+  // (e.g. 0.057 ms -> 56.999... µs) and truncation would change the value.
+  w.I64(bl.tuned_cycle_ms < 0 ? -1 : llround(bl.tuned_cycle_ms * 1000.0));
   w.U32(static_cast<uint32_t>(bl.batches.size()));
   for (const Batch& b : bl.batches) {
     w.U8(static_cast<uint8_t>(b.kind));
@@ -155,6 +161,9 @@ inline std::string SerializeBatchList(const BatchList& bl) {
 inline BatchList ParseBatchList(Reader& rd) {
   BatchList bl;
   bl.shutdown = rd.U8() != 0;
+  bl.tuned_threshold_bytes = rd.I64();
+  const int64_t cyc_us = rd.I64();
+  bl.tuned_cycle_ms = cyc_us < 0 ? -1.0 : cyc_us / 1000.0;
   // Min fixed bytes per batch: kind + error len + name count = 9.
   uint32_t n = rd.Count(9);
   bl.batches.reserve(n);

@@ -65,6 +65,14 @@ RequestList RandRequestList() {
 BatchList RandBatchList() {
   BatchList bl;
   bl.shutdown = Rand(0, 1) != 0;
+  // Tuned-knob piggyback: exercise unset (-1), zero, and large values.
+  bl.tuned_threshold_bytes = Rand(0, 3) == 0
+                                 ? -1
+                                 : static_cast<int64_t>(Rand(0, 1 << 30));
+  // Cycle time rides as integer micros; keep randoms on the µs grid so
+  // the float round-trip is exact by construction.
+  bl.tuned_cycle_ms =
+      Rand(0, 3) == 0 ? -1.0 : static_cast<double>(Rand(0, 100000)) / 1000.0;
   size_t n = Rand(0, 8);
   for (size_t i = 0; i < n; ++i) {
     Batch b;
@@ -92,6 +100,9 @@ bool EqualRL(const RequestList& a, const RequestList& b) {
 
 bool EqualBL(const BatchList& a, const BatchList& b) {
   if (a.shutdown != b.shutdown || a.batches.size() != b.batches.size())
+    return false;
+  if (a.tuned_threshold_bytes != b.tuned_threshold_bytes ||
+      a.tuned_cycle_ms != b.tuned_cycle_ms)
     return false;
   for (size_t i = 0; i < a.batches.size(); ++i) {
     const Batch &x = a.batches[i], &y = b.batches[i];

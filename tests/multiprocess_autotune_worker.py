@@ -1,0 +1,95 @@
+"""Worker: control-plane autotuning over the native controller.
+
+Rank 0 owns the Autotuner; each of its moves is installed into the native
+controller (``SetTuned``), which applies the threshold to the next tick's
+batch building and piggybacks (threshold, cycle) on every response — so
+every rank's ``config`` must move IDENTICALLY, tick-for-tick.  The
+reference-shaped behaviour later Horovod grew (rank-0 tunes, renegotiates
+through the control plane).
+
+Launched by tests/test_multiprocess.py with HOROVOD_AUTOTUNE=1, the native
+controller on, and fast tuner knobs.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+    from horovod_tpu.ops import eager as eager_ops
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    cfg = basics.config()
+    eng = eager_ops._engine()
+    assert eng.controller is not None, "native controller required"
+    if me == 0:
+        assert eng.autotuner is not None, "rank 0 must own the tuner"
+    else:
+        assert eng.autotuner is None, "only rank 0 tunes"
+
+    initial = cfg.fusion_threshold_bytes
+    # ~256 KiB per tensor so a 4-flush window clears the 1 MiB minimum.
+    grads = [
+        hvd.per_rank(lambda r: np.full((64 * 1024,), float(r), np.float32))
+        for _ in range(2)
+    ]
+    steps = 0
+    for step in range(400):
+        hvd.grouped_allreduce_eager(grads, average=True)
+        steps += 1
+        # The stop decision must be made by ONE rank and broadcast through
+        # the engine: rank 0 observes its tuner move at least a tick before
+        # the piggyback lands elsewhere, so a rank-local exit condition
+        # would desynchronize step counts and deadlock the negotiation.
+        # (_process_rank_major, not per_rank: the flag is process-LOCAL.)
+        from horovod_tpu.optim.distributed_optimizer import _process_rank_major
+
+        stop_local = 1.0 if (me == 0
+                             and cfg.fusion_threshold_bytes != initial) else 0.0
+        stop = hvd.broadcast(
+            _process_rank_major(np.asarray([stop_local], np.float32)),
+            root_rank=0, name=f"at.stop.{step}",
+        )
+        if float(np.asarray(jax.device_get(stop)).ravel()[0]) > 0.5:
+            break
+    # One more negotiated op so the final piggyback reaches every rank.
+    hvd.allreduce(hvd.per_rank(lambda r: np.ones((1,), np.float32)),
+                  name="at.drain")
+    final = (cfg.fusion_threshold_bytes, cfg.cycle_time_ms)
+
+    # Cross-check: every rank must hold the SAME final knobs, and they
+    # must have moved off the initial threshold.
+    from horovod_tpu.optim.distributed_optimizer import _process_rank_major
+
+    digest = _process_rank_major(
+        np.asarray([final[0], int(final[1] * 1000)], np.int32)
+    )
+    all_knobs = np.asarray(
+        jax.device_get(hvd.allgather(digest, name="at.knobs"))
+    ).reshape(n, 2)
+    assert (all_knobs == all_knobs[0]).all(), f"knobs diverged: {all_knobs}"
+    assert final[0] != initial, (
+        f"threshold never moved off {initial} in {steps} steps"
+    )
+    hvd.shutdown()
+    print("AUTOTUNE_OK " + json.dumps(
+        {"rank": me, "final_threshold": int(final[0]),
+         "final_cycle_ms": final[1], "steps": steps}
+    ), flush=True)
+
+
+if __name__ == "__main__":
+    main()

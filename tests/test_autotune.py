@@ -151,9 +151,13 @@ def test_engine_autotunes_under_eager_traffic():
         hvd.init()
 
 
-def test_autotune_ignored_with_native_controller(capsys):
-    """HOROVOD_AUTOTUNE with the native controller must warn and disable
-    (rank 0's fixed threshold owns fusion for every rank)."""
+def test_autotune_native_controller_rank0_owns_tuner():
+    """HOROVOD_AUTOTUNE with the native controller: rank 0 owns the tuner
+    and every move is wired into the controller (SetTuned), which governs
+    BuildBatches for the gang and piggybacks the knobs on each response —
+    the control-plane autotune the r2 engine refused.  The multi-rank
+    propagation is pinned by
+    test_multiprocess.py::test_control_plane_autotune_two_processes."""
     import uuid
 
     from horovod_tpu import native
@@ -170,9 +174,10 @@ def test_autotune_ignored_with_native_controller(capsys):
         hvd.allreduce(x, average=True)          # brings the engine up
         eng = hvd.ops.eager._engine()
         assert eng.controller is not None
-        assert eng.autotuner is None
-        err = capsys.readouterr().err
-        assert "HOROVOD_AUTOTUNE=1 ignored" in err
+        assert eng.autotuner is not None, (
+            "rank 0 must own the tuner under the native controller"
+        )
+        assert eng.autotuner.on_move == eng.controller.set_tuned
     finally:
         for k in ("HOROVOD_AUTOTUNE", "HOROVOD_TPU_NATIVE_CONTROLLER",
                   "HOROVOD_TPU_CONTROLLER_TRANSPORT"):

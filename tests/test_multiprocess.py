@@ -11,6 +11,7 @@ claims under actual process separation.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import subprocess
@@ -376,3 +377,29 @@ def test_pytorch_imagenet_resume_after_crash(tmp_path):
     # Only the post-resume epoch ran in launch 2.
     assert "epoch 2:" in r2.stdout and "epoch 1:" not in r2.stdout
     assert os.path.exists(os.path.join(ckpt_dir, "checkpoint-2.pt"))
+
+
+@pytest.mark.slow
+def test_control_plane_autotune_two_processes():
+    """HOROVOD_AUTOTUNE over the native controller (the multi-host config
+    the r2 engine refused): rank 0 tunes, installs moves via SetTuned, the
+    threshold governs rank-0's BuildBatches for the whole gang, and the
+    (threshold, cycle) pair piggybacks on every response — the worker
+    asserts every rank's config moved IDENTICALLY off the default."""
+    outs = _run_workers(
+        os.path.join(HERE, "multiprocess_autotune_worker.py"), 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+            "HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES": "4",
+        },
+        timeout=420,
+    )
+    finals = set()
+    for i, out in enumerate(outs):
+        assert "AUTOTUNE_OK" in out, f"worker {i} no OK line:\n{out}"
+        line = [l for l in out.splitlines() if l.startswith("AUTOTUNE_OK")][0]
+        finals.add(json.loads(line.split(" ", 1)[1])["final_threshold"])
+    assert len(finals) == 1, f"ranks converged to different thresholds: {finals}"
