@@ -1,0 +1,93 @@
+"""4-rank TCP-controller gang: ragged allgather + process sets + a mid-run
+worker kill whose recovery rides the launcher's ``--restarts`` gang
+restart.
+
+Attempt 1: phases 1-2 complete real collectives over the TCP control
+plane, then rank 2 dies abruptly (os._exit) MID-RUN — the other ranks are
+already blocked in the next negotiated collective, the launcher tears the
+gang down and relaunches it.  Attempt 2 (marker present) runs every phase
+to completion.  Exceeds the reference CI's ``mpirun -np 2`` everything
+(.travis.yml) in both width (4 ranks) and failure realism.
+
+Launched by tests/test_multiprocess.py::test_gang4_ragged_process_sets_restart.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvdt
+
+    hvdt.init()           # torch surface: one process per device
+    n, me = hvd.size(), hvd.rank()
+    assert n == 4, f"this worker expects a 4-rank world, got {n}"
+    first_attempt = not os.path.exists(os.environ["GANG4_MARKER"])
+
+    # --- phase 1: ragged allgather (per-rank first dims 1..4) negotiated
+    # through the engine, sliced back by the handle post payload.
+    mine = torch.full((me + 1, 2), float(me))
+    g = hvdt.allgather(mine, name="g4.ragged")
+    assert g.shape == (10, 2), g.shape
+    off = 0
+    for r in range(n):
+        rows = g[off:off + r + 1]
+        assert torch.all(rows == float(r)), (r, rows)
+        off += r + 1
+
+    # --- phase 2: process-set subset reductions with members and
+    # non-members on BOTH sides of real process boundaries.
+    ps = hvd.ProcessSet([0, 2])
+    x = hvd.from_per_rank(
+        [np.full((4,), float(10 * (r + 1)), np.float32) for r in range(n)]
+    )
+    out = hvd.allreduce(x, average=True, process_set=ps, name="g4.ps")
+    got = np.asarray(out.addressable_shards[0].data).reshape(-1)[:4]
+    want = 20.0 if me in (0, 2) else 10.0 * (me + 1)   # mean(10, 30) = 20
+    assert np.allclose(got, want), (me, got, want)
+
+    ps2 = hvd.ProcessSet([1, 2, 3])
+    out2 = hvd.allreduce(x, average=True, process_set=ps2, name="g4.ps2")
+    got2 = np.asarray(out2.addressable_shards[0].data).reshape(-1)[:4]
+    want2 = 30.0 if me in (1, 2, 3) else 10.0           # mean(20, 30, 40)
+    assert np.allclose(got2, want2), (me, got2, want2)
+
+    # --- phase 3 (attempt 1 only): rank 2 dies mid-run, abruptly.  The
+    # marker is written FIRST so the relaunched gang takes the happy path.
+    if first_attempt:
+        if me == 2:
+            open(os.environ["GANG4_MARKER"], "w").close()
+            print("GANG4-KILL rank 2 dying mid-run", flush=True)
+            os._exit(7)
+        # Peers head straight into the next collective and block on the
+        # dead rank until the launcher tears the gang down.
+        hvdt.allreduce(torch.ones(8), name="g4.after-kill")
+        raise AssertionError("collective completed despite a dead rank")
+
+    # --- phase 4: full-gang grouped allreduce after recovery.
+    outs = hvdt.grouped_allreduce(
+        [torch.full((8,), float(me)), torch.full((3,), float(2 * me))],
+        average=True,
+    )
+    assert torch.allclose(outs[0], torch.full((8,), 1.5)), outs[0]
+    assert torch.allclose(outs[1], torch.full((3,), 3.0)), outs[1]
+
+    hvd.shutdown()
+    print("GANG4_OK " + json.dumps({"rank": me, "size": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

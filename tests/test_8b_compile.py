@@ -1,0 +1,93 @@
+"""Compile proof for the flagship-scale claim (BASELINE config 5):
+the REAL Llama-3 8B configuration, with DP+TP shardings, lowers and
+compiles ahead-of-time on a virtual 8-device mesh — no parameter ever
+materializes (8B fp32 master weights would be 32 GB), only
+ShapeDtypeStructs flow in.
+
+What this pins:
+  * the 8B architecture builds (vocab 128256, dim 4096, 32 layers, GQA
+    8 kv-heads, ffn 14336, seq 8192, remat on, bf16 compute);
+  * Megatron-style TP specs from ``param_partition_specs`` + DP batch
+    sharding survive XLA SPMD partitioning at this scale;
+  * the partitioned program actually contains cross-device collectives
+    (the row-parallel psum TP implies);
+  * the parameter count is the 8B it claims to be.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd  # noqa: F401  (conftest owns the 8-dev world)
+from horovod_tpu.models import llama
+from horovod_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.slow
+def test_llama3_8b_dp_tp_aot_compile():
+    cfg = llama.llama3_8b()          # the real thing — no shrinking
+    n_params = llama.num_params(cfg)
+    assert 7.9e9 < n_params < 8.2e9, f"not 8B-scale: {n_params:,}"
+
+    mesh = make_mesh(dp=2, tp=4, devices=jax.devices())
+    pspecs = llama.param_partition_specs(cfg, tp_axis="tp")
+    param_sharding = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    batch_sharding = NamedSharding(mesh, P(("dp",), None))
+
+    loss_fn = llama.make_loss_fn(cfg)
+    tx = optax.adamw(1e-4)
+
+    # Abstract everything: shapes/dtypes only, never a real buffer.
+    params_abs = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k), jax.random.key(0)
+    )
+    opt_abs = jax.eval_shape(tx.init, params_abs)
+    batch_abs = tuple(
+        jax.ShapeDtypeStruct((4, cfg.max_seq_len), jnp.int32,
+                             sharding=batch_sharding)
+        for _ in range(2)
+    )
+    params_abs = jax.tree.map(
+        lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype,
+                                             sharding=sh),
+        params_abs, param_sharding,
+    )
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # AOT: lower with the param shardings pinned; opt-state shardings are
+    # left to SPMD propagation (they mirror the params leaf-for-leaf).
+    lowered = jax.jit(step).lower(params_abs, opt_abs, batch_abs)
+    stablehlo = lowered.as_text()
+    assert "sdy.sharding" in stablehlo or "mhlo.sharding" in stablehlo, (
+        "no sharding annotations survived lowering"
+    )
+
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    # TP row-parallel matmuls force cross-device reduction collectives.
+    assert ("all-reduce" in hlo) or ("reduce-scatter" in hlo), (
+        "partitioned 8B program contains no reduction collective"
+    )
+
+    # Per-device peak memory must be a ~quarter-ish of the global model
+    # state (tp=4 shards params/grads/adam moments; dp replicates), i.e.
+    # far below the unsharded 32 GB fp32 params alone — proof the specs
+    # actually sharded the big tensors rather than replicating them.
+    mem = compiled.memory_analysis()
+    if mem is not None and getattr(mem, "argument_size_in_bytes", 0):
+        per_dev_args = mem.argument_size_in_bytes
+        global_state_bytes = n_params * 4 * 4     # params+grads+mu+nu fp32
+        assert per_dev_args < global_state_bytes / 2, (
+            f"arguments not sharded: {per_dev_args / 1e9:.1f} GB on one "
+            "device"
+        )

@@ -403,3 +403,31 @@ def test_control_plane_autotune_two_processes():
         line = [l for l in out.splitlines() if l.startswith("AUTOTUNE_OK")][0]
         finals.add(json.loads(line.split(" ", 1)[1])["final_threshold"])
     assert len(finals) == 1, f"ranks converged to different thresholds: {finals}"
+
+
+@pytest.mark.slow
+def test_gang4_ragged_process_sets_restart(tmp_path):
+    """nproc=4 over the TCP controller: ragged allgather, two process
+    sets spanning real process boundaries, then a mid-run rank-2 kill
+    recovered by the launcher's --restarts gang restart — wider and more
+    failure-realistic than the reference CI's mpirun -np 2 everything
+    (.travis.yml)."""
+    env = dict(os.environ)
+    # The launcher owns the controller transport (a fresh auto port per
+    # restart attempt — launch.py avoids the TIME_WAIT rebind hazard of a
+    # fixed port) and pops XLA_FLAGS itself under --cpu.
+    env.update(
+        HOROVOD_TPU_NATIVE_CONTROLLER="on",
+        GANG4_MARKER=str(tmp_path / "gang4.attempted"),
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "4",
+         "--cpu", "--restarts", "2", "--", sys.executable,
+         os.path.join(HERE, "multiprocess_gang4_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout[-4000:], r.stderr[-4000:])
+    assert "GANG4-KILL rank 2 dying mid-run" in r.stdout
+    assert "restarting (1/2)" in r.stderr, r.stderr[-2000:]
+    assert r.stdout.count("GANG4_OK") == 4, r.stdout[-4000:]
