@@ -181,47 +181,148 @@ class Int8Compressor(Compressor):
     LEVELS = 127.0
 
     @classmethod
-    def _block_quantize(cls, tensor: jax.Array):
+    def _scale_for(cls, x: jax.Array) -> jax.Array:
+        """Block scales for ``x`` [nb, B] — part of the single wire
+        definition (all-zero blocks guarded)."""
+        return jnp.maximum(
+            jnp.max(jnp.abs(x), axis=1, keepdims=True) / cls.LEVELS, 1e-30
+        )
+
+    @classmethod
+    def _block_quantize(cls, tensor: jax.Array, *, block_multiple: int = 1):
         """The wire's quantizer — THE single definition of the format.
 
         Returns ``(codes [nb, ...], scale f32 [nb, 1], n)`` where ``n`` is
-        the unpadded flat length.  Both the collective and the
-        error-feedback residual (ops/powersgd.py) go through here, so the
-        residual can never drift from what the wire actually carried.
+        the unpadded flat length.  Both the collective (one- AND two-shot;
+        ``block_multiple`` pads the block count so ranks own equal shards)
+        and the error-feedback residual (ops/powersgd.py) go through here,
+        so the residual can never drift from what the wire actually
+        carried.
         """
         flat = tensor.astype(jnp.float32).reshape(-1)
         n = flat.shape[0]
         nblocks = -(-n // cls.BLOCK)
+        nblocks += (-nblocks) % block_multiple
         pad = nblocks * cls.BLOCK - n
         if pad:
             flat = jnp.pad(flat, (0, pad))
         x = flat.reshape(nblocks, cls.BLOCK)
-        scale = jnp.max(jnp.abs(x), axis=1, keepdims=True) / cls.LEVELS
-        scale = jnp.maximum(scale, 1e-30)          # all-zero block guard
+        scale = cls._scale_for(x)
         return cls._encode(x, scale), scale, n
 
     @classmethod
     def roundtrip(cls, tensor: jax.Array) -> jax.Array:
         """quant→dequant of ``tensor`` through the exact wire format — what
-        this rank's contribution looks like after the collective."""
+        this rank's contribution looks like after the collective.
+
+        Models the FIRST quantization only: on the two-shot path the
+        reduced shard is rounded a second time before the all-gather, a
+        component an ErrorFeedback residual built from this estimate does
+        not see (it is bounded by one quantization step of the SUM, and
+        shrinks as gradients do)."""
         codes, scale, n = cls._block_quantize(tensor)
         out = cls._decode(codes, scale).reshape(-1)[:n]
         return out.reshape(tensor.shape)
 
+    # Above this world size the two-shot path is the default: received
+    # wire is ~2C vs the one-shot all-gather's (n-1)·C, so one-shot only
+    # competes in tiny worlds (and costs one fewer rounding step there).
+    TWO_SHOT_MIN_WORLD = 5
+
+    @classmethod
+    def one_shot(cls):
+        """Variant pinned to the one-shot wire at every world size.
+
+        The ErrorFeedback path uses this: ``roundtrip`` models the first
+        quantization exactly, so with one-shot the residual matches the
+        wire bit-for-bit; the two-shot path's second rounding would leak
+        past the residual — the exact bias EF exists to eliminate."""
+        v = cls.__dict__.get("_one_shot_variant")
+        if v is None:
+            v = type(cls.__name__ + "OneShot", (cls,),
+                     {"TWO_SHOT_MIN_WORLD": 1 << 62})
+            cls._one_shot_variant = v
+        return v
+
     @classmethod
     def quantized_allreduce(cls, tensor: jax.Array, *, average: bool = False,
-                            axis_name="hvd") -> jax.Array:
+                            axis_name="hvd",
+                            two_shot: bool | None = None) -> jax.Array:
+        """Quantized all-reduce with a scale-aware wire.
+
+        Two dataflows, auto-selected by world size (``two_shot=None``):
+
+        * **one-shot** (small worlds): all_gather the codes+scales, every
+          rank dequantizes and sums in fp32.  Received bytes: ``(n-1)·C``
+          where C is the compressed payload — past a handful of ranks the
+          "compression" moves more wire than an uncompressed psum.
+        * **two-shot** (``n >= TWO_SHOT_MIN_WORLD``): quantized
+          reduce-scatter then quantized all-gather — the ZeRO++-style
+          scheme.  Each rank all-to-alls its code shards (receives
+          ``(n-1)/n·C``), dequant-sums its shard in fp32, REQUANTIZES the
+          partial sum, and all-gathers the compressed shard (another
+          ``(n-1)/n·C``): ~``2C`` received regardless of n, at the cost of
+          a second rounding step (wrap in ErrorFeedback for bias-freedom).
+
+        Tuple axis names (hierarchical meshes) always take the one-shot
+        path: the shard exchange is defined over a single flat axis.
+        """
         orig_dtype, orig_shape = tensor.dtype, tensor.shape
-        codes, scale, n = cls._block_quantize(tensor)
-        all_q = lax.all_gather(codes, axis_name)   # [size, nb, ...] wire
-        all_s = lax.all_gather(scale, axis_name)   # [size, nb, 1] f32
-        summed = jnp.sum(
-            jax.vmap(cls._decode)(all_q, all_s), axis=0
+        flat_axis = not isinstance(axis_name, (tuple, list))
+        if two_shot is None:
+            two_shot = False
+            if flat_axis:
+                sz = lax.axis_size(axis_name)
+                nb1 = -(-int(tensor.size) // cls.BLOCK)
+                nb2 = nb1 + (-nb1) % sz      # padded to equal shards
+                # Only when it actually saves wire: one-shot receives
+                # (n-1)·nb1 blocks, two-shot ~2·nb2 (tiny tensors pad up
+                # and would move MORE with an extra rounding on top).
+                two_shot = (sz >= cls.TWO_SHOT_MIN_WORLD
+                            and (sz - 1) * nb1 > 2 * nb2)
+        if two_shot and not flat_axis:
+            raise ValueError(
+                "two-shot quantized allreduce needs a single flat axis; "
+                f"got axis_name={axis_name!r}"
+            )
+        if not two_shot:
+            codes, scale, n = cls._block_quantize(tensor)
+            all_q = lax.all_gather(codes, axis_name)   # [size, nb, ...] wire
+            all_s = lax.all_gather(scale, axis_name)   # [size, nb, 1] f32
+            summed = jnp.sum(
+                jax.vmap(cls._decode)(all_q, all_s), axis=0
+            )
+            if average:
+                summed = summed / all_q.shape[0]  # works for tuple axes too
+            out = summed.reshape(-1)[:n]
+            return out.reshape(orig_shape).astype(orig_dtype)
+
+        size = lax.axis_size(axis_name)
+        # The shared wire quantizer, block count padded to a multiple of
+        # the world size so every rank owns an equal shard of blocks.
+        codes, scale, n = cls._block_quantize(tensor, block_multiple=size)
+        m = codes.shape[0] // size
+        # Shot 1 — quantized reduce-scatter: exchange code shards so rank r
+        # holds every rank's blocks [r*m, (r+1)*m), then dequant-sum fp32.
+        sh_codes = codes.reshape(size, m, codes.shape[-1])
+        sh_scale = scale.reshape(size, m, 1)
+        recv_codes = lax.all_to_all(
+            sh_codes, axis_name, split_axis=0, concat_axis=0, tiled=True
+        )                                           # [size, m, .] wire
+        recv_scale = lax.all_to_all(
+            sh_scale, axis_name, split_axis=0, concat_axis=0, tiled=True
         )
+        part = jnp.sum(jax.vmap(cls._decode)(recv_codes, recv_scale), axis=0)
         if average:
-            summed = summed / all_q.shape[0]   # works for tuple axis_names too
-        out = summed.reshape(-1)[:n]
-        return out.reshape(orig_shape).astype(orig_dtype)
+            part = part / size                      # [m, B] fp32 shard sum
+
+        # Shot 2 — requantize the reduced shard, all-gather the codes.
+        scale2 = cls._scale_for(part)
+        codes2 = cls._encode(part, scale2)
+        all_q = lax.all_gather(codes2, axis_name)   # [size, m, .] wire
+        all_s = lax.all_gather(scale2, axis_name)
+        full = jax.vmap(cls._decode)(all_q, all_s).reshape(-1)[:n]
+        return full.reshape(orig_shape).astype(orig_dtype)
 
 
 class Int4Compressor(Int8Compressor):

@@ -108,8 +108,15 @@ class ErrorFeedback:
                 dense = dense / _axis_size(axis_name)
             return dense.reshape(corrected.shape).astype(g.dtype), residual
         # int8: residual is this rank's own quantization error, computed by
-        # the wire's own quantizer so the two can never drift.
-        reduced = type(self.inner).quantized_allreduce(
+        # the wire's own quantizer so the two can never drift.  One-shot is
+        # forced (via the one_shot() variant when the compressor offers
+        # one — third-party protocol conformers keep their own default):
+        # the residual models the FIRST quantization exactly, and the
+        # two-shot path's second rounding would leak past it.
+        cls = type(self.inner)
+        if callable(getattr(cls, "one_shot", None)):
+            cls = cls.one_shot()
+        reduced = cls.quantized_allreduce(
             corrected, average=average, axis_name=axis_name
         )
         return reduced.astype(g.dtype), residual

@@ -217,8 +217,14 @@ class EagerDistributedOptimizer:
                 ratio=inner.ratio, k=inner.k,
             )
         else:                                 # quantized wire (int8/int4)
-            # ErrorFeedback.__init__ normalizes inner to an instance.
+            # ErrorFeedback.__init__ normalizes inner to an instance.  The
+            # one-shot variant keeps the residual exact (see
+            # Int8Compressor.one_shot); two-shot's second rounding would
+            # leak past it.  Third-party protocol conformers without a
+            # one_shot() keep their own default.
             cls = type(inner)
+            if callable(getattr(cls, "one_shot", None)):
+                cls = cls.one_shot()
             h = eager_ops.allreduce_async(
                 corrected, name=name, op=self.op,
                 compression=cls, no_fuse=True,

@@ -47,12 +47,14 @@ def test_int4_roundtrip_error_bounded():
 
 
 def test_int4_allreduce_sums_quantized_contributions():
+    """One-shot wire: the result is EXACTLY the sum of per-rank roundtrips
+    (pinned via .one_shot(); at this world size the default is two-shot)."""
     n = hvd.size()
     rng = np.random.RandomState(2)
     per_rank = rng.randn(n, 2500).astype(np.float32)
     f = _smap(
         lambda a: ops.allreduce(
-            a[0], op=ops.Sum, compression=hvd.Compression.int4
+            a[0], op=ops.Sum, compression=hvd.Compression.int4.one_shot()
         )
     )
     out = np.asarray(f(jnp.asarray(per_rank)))
@@ -61,6 +63,35 @@ def test_int4_allreduce_sums_quantized_contributions():
         for r in range(n)
     )
     np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_int4_two_shot_default_and_error_bounded():
+    """At world size >= TWO_SHOT_MIN_WORLD the default wire is two-shot
+    (quantized reduce-scatter + quantized all-gather, ~2C received instead
+    of (n-1)C).  Its extra rounding is bounded by one quantization step of
+    the SUM per element: |out - one_shot_sum| <= maxabs(shard sum)/LEVELS."""
+    n = hvd.size()
+    assert n >= Int4Compressor.TWO_SHOT_MIN_WORLD, "mesh too small"
+    rng = np.random.RandomState(7)
+    per_rank = rng.randn(n, 3000).astype(np.float32)
+    f = _smap(
+        lambda a: ops.allreduce(
+            a[0], op=ops.Sum, compression=hvd.Compression.int4
+        )
+    )
+    out = np.asarray(f(jnp.asarray(per_rank)))
+    one_shot = sum(
+        np.asarray(Int4Compressor.roundtrip(jnp.asarray(per_rank[r])))
+        for r in range(n)
+    )
+    # Per-1024-block bound on the second rounding step.
+    B = Int4Compressor.BLOCK
+    padded = np.pad(one_shot, (0, -len(one_shot) % B)).reshape(-1, B)
+    bound = np.abs(padded).max(1, keepdims=True) / Int4Compressor.LEVELS + 1e-5
+    err = np.abs(np.pad(out - one_shot, (0, -len(one_shot) % B))).reshape(-1, B)
+    assert (err <= bound).all(), (err.max(), bound.min())
+    # And it is not literally the one-shot result (the wire really changed).
+    assert not np.allclose(out, one_shot, atol=1e-7)
 
 
 def test_int4_average_matches_sum_over_n():
