@@ -471,19 +471,54 @@ def main() -> None:
     print(json.dumps(line))
 
 
+def _failure_line(error_msg: str) -> str:
+    """The one definition of the parseable failure artifact (used by the
+    exception path AND the watchdog — keep them from drifting)."""
+    return json.dumps({
+        "metric": "resnet101_synthetic_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": error_msg,
+        "extras": {"tpu_probe": _probe_report} if _probe_report else {},
+    })
+
+
+def _arm_watchdog() -> None:
+    """Hard wall-clock bound on the WHOLE bench.
+
+    The subprocess probe protects backend *init*, but a tunnel that dies
+    mid-bench leaves a device future that never resolves — no try/except
+    can unblock ``block_until_ready``, and a SIGALRM handler would never
+    run either (Python signal handlers need the main thread to re-enter
+    the interpreter loop, which a C-blocked ``block_until_ready`` never
+    does).  A daemon timer THREAD fires regardless of where the main
+    thread is stuck, emits the parseable failure line, and exits.
+    """
+    import threading
+
+    limit = float(os.environ.get("HVD_TPU_BENCH_HARD_LIMIT", "840"))
+
+    def on_timeout():
+        print(_failure_line(
+            f"hard watchdog fired after {limit:.0f}s "
+            "(device future never resolved; tunnel died mid-run?)"
+        ), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(limit, on_timeout)
+    t.daemon = True
+    t.start()
+
+
 if __name__ == "__main__":
     import sys
     import traceback
 
+    _arm_watchdog()
     try:
         main()
     except Exception as exc:  # emit a parseable line no matter what
         traceback.print_exc()
-        print(json.dumps({
-            "metric": "resnet101_synthetic_images_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(exc).__name__}: {exc}",
-        }))
+        print(_failure_line(f"{type(exc).__name__}: {exc}"))
         sys.exit(0)

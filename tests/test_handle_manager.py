@@ -1,0 +1,66 @@
+"""HandleManager unit coverage (reference handle_manager.h/.cc parity plus
+the post-payload surface the torch frontend rides)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.ops.handle_manager import HandleManager
+
+
+def test_lifecycle_and_post_payload():
+    hm = HandleManager()
+    h = hm.allocate("t")
+    assert hm.name(h) == "t"
+    hm.set_post(h, {"ragged": (3, (1, 2))})
+    assert hm.take_post(h) == {"ragged": (3, (1, 2))}
+    assert hm.take_post(h) is None          # detached exactly once
+    hm.mark_dispatched(h, 42)
+    assert hm.poll(h)
+    assert hm.wait(h, flush=lambda: None) == 42
+    with pytest.raises(ValueError):          # released by wait
+        hm.poll(h)
+
+
+def test_update_post_merges_atomically():
+    hm = HandleManager()
+    h = hm.allocate()
+    hm.update_post(h, {"dtype": "int64"})
+    hm.update_post(h, {"rank_major": True})
+    assert hm.take_post(h) == {"dtype": "int64", "rank_major": True}
+
+
+def test_released_handle_is_tolerated_by_marks_and_posts():
+    """An error-path release() can drop a handle whose op is still queued;
+    the eventual dispatch marks must no-op instead of blowing up mid-batch
+    (which would strand fused-group peers)."""
+    hm = HandleManager()
+    h = hm.allocate("gone")
+    hm.release(h)
+    hm.mark_dispatched(h, 1)                 # must not raise
+    hm.mark_error(h, RuntimeError("late"))   # must not raise
+    hm.set_post(h, {"x": 1})                 # must not raise
+    hm.update_post(h, {"y": 2})
+    assert hm.take_post(h) is None
+    assert hm.outstanding() == 0
+
+
+def test_wait_raises_captured_error_and_releases():
+    hm = HandleManager()
+    h = hm.allocate()
+    hm.mark_error(h, RuntimeError("boom"))
+    assert hm.poll(h)
+    with pytest.raises(RuntimeError, match="boom"):
+        hm.wait(h, flush=lambda: None)
+    assert hm.outstanding() == 0
+
+
+def test_wait_blocks_until_marked_from_another_thread():
+    hm = HandleManager()
+    h = hm.allocate()
+    t = threading.Timer(0.05, lambda: hm.mark_dispatched(h, "late-ok"))
+    t.start()
+    try:
+        assert hm.wait(h, flush=lambda: None) == "late-ok"
+    finally:
+        t.cancel()
