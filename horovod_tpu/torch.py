@@ -68,16 +68,46 @@ mpi_threads_supported = _hvd.mpi_threads_supported
 is_initialized = _hvd.is_initialized
 
 
+def _torch_to_np(t) -> np.ndarray:
+    """torch tensor → numpy, bridging bfloat16 (numpy has no native bf16;
+    torch refuses .numpy() on it) through a uint16 view into ml_dtypes —
+    bf16 is THE TPU dtype, so the frontend must carry it losslessly."""
+    torch = _torch()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        # int16 view, not uint16: same 2-byte bitcast, but torch.uint16
+        # only exists in torch >= 2.3 and would silently raise this
+        # module's torch floor.
+        raw = t.detach().cpu().contiguous().view(torch.int16).numpy()
+        return raw.view(ml_dtypes.bfloat16).reshape(tuple(t.shape))
+    # ascontiguousarray promotes 0-dim to 1-dim; reshape restores the true
+    # shape so scalars (e.g. BatchNorm's num_batches_tracked in a
+    # state_dict broadcast) don't grow a bogus axis.
+    return np.ascontiguousarray(t.detach().cpu().numpy()).reshape(
+        tuple(t.shape)
+    )
+
+
+def _np_to_torch(a: np.ndarray):
+    """numpy → torch, bridging ml_dtypes.bfloat16 the same way."""
+    import ml_dtypes
+
+    torch = _torch()
+    if a.dtype == ml_dtypes.bfloat16:
+        # ascontiguousarray promotes 0-dim to 1-dim; reshape restores it
+        # (same footgun as _torch_to_np).  int16 view: see _torch_to_np.
+        raw = np.ascontiguousarray(a).view(np.int16)
+        return (torch.from_numpy(raw.copy()).view(torch.bfloat16)
+                .reshape(tuple(a.shape)))
+    return torch.from_numpy(np.array(a))
+
+
 def _to_rank_major(t) -> Any:
     """This process's torch tensor → its row of the rank-major array."""
     import jax
 
-    # ascontiguousarray promotes 0-dim to 1-dim; reshape restores the true
-    # shape so scalars (e.g. BatchNorm's num_batches_tracked in a
-    # state_dict broadcast) don't grow a bogus axis.
-    local = np.ascontiguousarray(t.detach().cpu().numpy()).reshape(
-        tuple(t.shape)
-    )
+    local = _torch_to_np(t)
     if local.dtype == np.int64:
         # The wire is int32 (jax x64 is off); a silently wrapped value
         # would corrupt the collective, so reject out-of-range up front.
@@ -98,8 +128,7 @@ def _to_rank_major(t) -> Any:
 def _to_torch(arr) -> Any:
     import jax
 
-    torch = _torch()
-    return torch.from_numpy(np.array(jax.device_get(arr)))
+    return _np_to_torch(np.asarray(jax.device_get(arr)))
 
 
 # ---------------------------------------------------------------------- ops
@@ -315,7 +344,7 @@ def synchronize(handle: int):
     torch = _torch()
     if post.get("rank_major"):
         local = np.asarray(raw.addressable_shards[0].data)[0]
-        out = torch.from_numpy(np.array(local))
+        out = _np_to_torch(local)
     else:
         out = _to_torch(raw)
         rag = post.get("ragged")

@@ -222,6 +222,27 @@ def main() -> None:
         "ranks diverged under disjoint-grad force-allreduce"
     )
 
+    # --- Dtype matrix (the reference's test_torch.py iterates dtypes for
+    # every op): allreduce/broadcast across the wire must hand back the
+    # caller's dtype — including the narrowed int64/float64 round-trips.
+    for dt, val in [(torch.float32, 1.5), (torch.float16, 2.0),
+                    (torch.bfloat16, 0.5), (torch.int32, 3),
+                    (torch.uint8, 7), (torch.int64, 9),
+                    (torch.float64, 1.25)]:
+        t = torch.full((5,), val, dtype=dt)
+        r = hvd.allreduce(t, average=False, name=f"t.dt.{dt}")
+        assert r.dtype == dt, (dt, r.dtype)
+        assert torch.allclose(r.float(), torch.full((5,), float(val) * n)), (
+            dt, r)
+        b = hvd.broadcast(torch.full((3,), val, dtype=dt) * (me + 1), 1,
+                          name=f"t.bc.{dt}")
+        assert b.dtype == dt and torch.allclose(
+            b.float(), torch.full((3,), float(val) * 2)
+        ), (dt, b)
+    bl = hvd.broadcast(torch.tensor([me == 0, True, False]), 0,
+                       name="t.bc.bool")
+    assert bl.dtype == torch.bool and bl.tolist() == [True, True, False], bl
+
     # --- Scalar + int64 round-trip: a state_dict broadcast carries 0-dim
     # LongTensors (BatchNorm num_batches_tracked); shape AND dtype must
     # survive the int32 wire (regression: ascontiguousarray 0-dim
@@ -229,6 +250,10 @@ def main() -> None:
     s = torch.tensor(41 + me)                       # 0-dim int64
     sb = hvd.broadcast(s, 0, name="t.scalar")
     assert sb.shape == () and sb.dtype == torch.int64 and int(sb) == 41, sb
+    sbf = hvd.broadcast(torch.tensor(2.5 + me, dtype=torch.bfloat16), 0,
+                        name="t.scalar.bf16")       # 0-dim bf16
+    assert sbf.shape == () and sbf.dtype == torch.bfloat16, sbf
+    assert float(sbf) == 2.5, sbf
     try:
         hvd.broadcast(torch.tensor(2 ** 40), 0, name="t.overflow")
         raise AssertionError("int64 overflow should be rejected")
