@@ -174,6 +174,13 @@ def _aot_compile(step, *args):
     if hasattr(step, "lower"):
         try:
             compiled = step.lower(*args).compile()
+        except Exception:
+            compiled = None     # args untouched; direct-call fallback below
+        if compiled is not None:
+            # Execution errors must PROPAGATE, not fall back: with buffer
+            # donation the warmup call consumes params/opt_state, and a
+            # retry through the direct path would die on deleted arrays,
+            # masking the real failure (OOM, collective error, ...).
             out = compiled(*args)       # validation + warmup in one call
             jax.block_until_ready(out)
             flops = None
@@ -185,8 +192,6 @@ def _aot_compile(step, *args):
             except Exception:
                 pass
             return compiled, flops, out
-        except Exception:
-            pass
     out = step(*args)
     jax.block_until_ready(out)
     return step, None, out
@@ -260,7 +265,10 @@ def _bench_resnet(hvd, on_tpu: bool) -> dict:
     tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
     opt_state = tx.init(params)
     step, flops, out = _aot_compile(
-        hvd.make_train_step(loss_fn, tx, donate=False),
+        # donate: real training reuses the params/opt buffers every step;
+        # benchmarking without donation would overstate HBM pressure and
+        # understate achievable batch (CPU sim ignores it with a warning).
+        hvd.make_train_step(loss_fn, tx, donate=on_tpu),
         params, opt_state, (images, labels),
     )
     state = {"p": out.params, "o": out.opt_state}
@@ -314,7 +322,7 @@ def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
     )
     batch = (tokens, tokens)
     step, flops, out = _aot_compile(
-        hvd.make_train_step(loss, tx, donate=False),
+        hvd.make_train_step(loss, tx, donate=on_tpu),
         params, opt_state, batch,
     )
     state = {"p": out.params, "o": out.opt_state}
