@@ -470,3 +470,42 @@ def test_wire_parsers_fuzz_under_sanitizers(tmp_path):
     )
     assert run.returncode == 0, run.stdout + run.stderr
     assert "wire fuzz OK" in run.stdout
+
+
+def test_set_tuned_piggyback_and_rebucketing():
+    """Control-plane autotune at the controller level: rank 0's SetTuned
+    (a) re-buckets the NEXT tick with the new threshold — batching is
+    rank-0-owned — and (b) piggybacks (threshold, cycle) on every rank's
+    response, sub-millisecond cycle values surviving the micros wire
+    exactly.  Non-root SetTuned must be a no-op."""
+    f32 = "float32"
+
+    def body(rank, ctrl):
+        seen = []
+        # Non-root set_tuned must not influence anything.
+        if rank == 1:
+            ctrl.set_tuned(1, 99.0)
+        # Round 1: default threshold (1 MiB) fuses two 1 KiB allreduces.
+        ctrl.submit(AR, f32, "a", (256,))
+        ctrl.submit(AR, f32, "b", (256,))
+        batches = drain(ctrl, 2)
+        seen.append(sorted(batches[0].names) if len(batches) == 1 else None)
+        # Rank 0 tunes: threshold 1 byte (nothing fuses), cycle 0.057 ms
+        # (the llround-sensitive value the fuzz harness flagged).
+        if rank == 0:
+            ctrl.set_tuned(1, 0.057)
+        bl = ctrl.tick()                     # propagation tick
+        ctrl.submit(AR, f32, "c", (256,))
+        ctrl.submit(AR, f32, "d", (256,))
+        batches2 = drain(ctrl, 2)
+        seen.append([b.names for b in batches2])
+        # The piggyback must reach every rank with exact values.
+        bl2 = ctrl.tick()
+        seen.append((bl2.tuned_threshold_bytes, bl2.tuned_cycle_ms))
+        return seen
+
+    results = run_ranks(2, body)
+    for r in results:
+        assert r[0] == ["a", "b"], r          # fused under the default
+        assert r[1] == [["c"], ["d"]], r      # split after SetTuned(1)
+        assert r[2] == (1, 0.057), r          # exact piggyback everywhere
