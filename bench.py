@@ -14,6 +14,8 @@ Beyond the reference's img/sec, the primary line carries TPU-first metrics:
 
 * ``mfu`` — model FLOPs utilization, computed from XLA's own cost analysis
   of the compiled step (not hand-counted FLOPs) against the chip's peak.
+* ``extras.resnet50_*`` — the same training step on ResNet-50
+  (BASELINE.json's headline metric model; TPU runs only).
 * ``extras.llama_*`` — tokens/sec/chip + MFU on a ~110M-param Llama with the
   pallas flash-attention kernel at seq 2048 (the flagship-model hot path).
 * ``extras.fusion_speedup`` — VGG-16-shaped eager gradient set pushed
@@ -217,8 +219,11 @@ def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
     return sum(rates) / len(rates)
 
 
-def _bench_resnet(hvd, on_tpu: bool) -> dict:
-    from horovod_tpu.models.resnet import ResNet101
+def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
+    """``depth`` selects ResNet-101 (the reference's published-number
+    config, the primary metric) or ResNet-50 (BASELINE.json's headline
+    metric and the reference's in-repo harness model)."""
+    import horovod_tpu.models.resnet as resnet_mod
 
     batch_per_chip = int(
         os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "2")
@@ -233,7 +238,9 @@ def _bench_resnet(hvd, on_tpu: bool) -> dict:
         os.environ.get("HVD_TPU_BENCH_BATCHES", "10" if on_tpu else "3")
     )
     n = hvd.size()
-    model = ResNet101(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = getattr(resnet_mod, f"ResNet{depth}")(
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32
+    )
 
     global_bs = batch_per_chip * n
     # Random synthetic data, not constants: a constant operand is an
@@ -284,6 +291,18 @@ def _bench_resnet(hvd, on_tpu: bool) -> dict:
         "images_per_sec_per_chip": round(per_chip, 2),
         "mfu": _mfu(flops, steps_per_sec),
         "flops_per_step": flops,
+    }
+
+
+def _bench_resnet50(hvd, on_tpu: bool) -> dict:
+    """BASELINE.json's primary metric model (extras arm; TPU only — the
+    CPU fallback keeps its single stable smoke number)."""
+    if not on_tpu:
+        return {"resnet50_skipped": "cpu_fallback_times_resnet101_only"}
+    r = _bench_resnet(hvd, on_tpu, depth=50)
+    return {
+        "resnet50_images_per_sec_per_chip": r["images_per_sec_per_chip"],
+        "resnet50_mfu": r["mfu"],
     }
 
 
@@ -450,7 +469,10 @@ def main() -> None:
         extras["tpu_unavailable_fell_back_to_cpu"] = True
     # Optional sub-benchmarks, each fenced by the remaining time budget so
     # the primary JSON line is never lost to a driver timeout.
-    for fn in (_bench_llama, _bench_fusion, _bench_llama_fused):
+    # New arms go LAST: under the budget fence, the arms earlier rounds
+    # already recorded (llama/fusion) keep priority for comparability.
+    for fn in (_bench_llama, _bench_fusion, _bench_llama_fused,
+               _bench_resnet50):
         if time.monotonic() - t_start > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue
