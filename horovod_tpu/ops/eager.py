@@ -1006,12 +1006,6 @@ def poll(handle: int) -> bool:
     return eng.handles.poll(handle)
 
 
-def set_handle_post(handle: int, payload) -> None:
-    """Attach frontend post-processing state to a live handle (stored in the
-    HandleManager entry, under its lock, released with the handle)."""
-    _engine().handles.set_post(handle, payload)
-
-
 def take_handle_post(handle: int):
     """Detach the handle's post payload; None if absent/released."""
     return _engine().handles.take_post(handle)
