@@ -1,0 +1,111 @@
+"""Pipeline-parallel training — GPipe-style stages over the ``pp`` mesh axis.
+
+Beyond reference parity (the reference is data-parallel only, SURVEY §2.3):
+a depth-sharded model where each mesh position owns ONE stage, microbatches
+stream through one ``lax.ppermute`` hop per tick, and the whole fill +
+steady-state + drain schedule is a single compiled ``lax.scan`` — no
+per-microbatch Python dispatch.  Backward derives automatically: ppermute
+transposes to the reverse hop under ``jax.grad``.
+
+Run on the 8-device CPU mesh (or any TPU slice):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/pipeline_mlp.py --stages 4 --microbatches 8
+
+What to look at:
+  * ``stack_stage_params`` — per-stage pytrees stacked on a leading axis
+    the ``P('pp')`` in_spec consumes;
+  * ``pipeline_loss_fn`` — masks the loss to the last stage and
+    replicates the scalar without double-counting gradients;
+  * the loss goes DOWN while every parameter lives on exactly one stage.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.pipeline import pipeline_loss_fn, stack_stage_params
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--microbatches", type=int, default=8)
+    p.add_argument("--microbatch-size", type=int, default=8)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    devs = jax.devices()[: args.stages]
+    if len(devs) != args.stages:
+        raise SystemExit(
+            f"--stages {args.stages} needs that many devices; only "
+            f"{len(devs)} visible.  On CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={args.stages} (a "
+            "smaller mesh would silently train only a subset of stages)."
+        )
+    mesh = Mesh(np.asarray(devs), ("pp",))
+    d = args.width
+
+    # One residual MLP block per stage (identical widths keep activations
+    # one shape across stages — the pipeline contract).
+    def stage_fn(params, h):
+        return h + jnp.tanh(h @ params["w"] + params["b"])
+
+    rng = np.random.default_rng(0)
+    stage_params = stack_stage_params([
+        {"w": jnp.asarray(rng.normal(0, 0.3, (d, d)), jnp.float32),
+         "b": jnp.zeros((d,), jnp.float32)}
+        for _ in range(args.stages)
+    ])
+
+    def loss_fn(y, target):
+        return jnp.mean((y - target) ** 2)
+
+    pipe_loss = pipeline_loss_fn(stage_fn, loss_fn)
+    smapped = jax.shard_map(
+        pipe_loss, mesh=mesh,
+        in_specs=(P("pp"), (P(), P())), out_specs=P(),
+        check_vma=False,
+    )
+
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(stage_params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda sp: smapped(sp, batch)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Synthetic regression task: match a fixed random linear map.
+    m, mb = args.microbatches, args.microbatch_size
+    x = jnp.asarray(rng.normal(0, 1, (m, mb, d)), jnp.float32)
+    w_true = jnp.asarray(rng.normal(0, 0.5, (d, d)), jnp.float32)
+    target = jnp.tanh(x @ w_true)
+    sharding = NamedSharding(mesh, P("pp"))
+    stage_params = jax.device_put(stage_params, sharding)
+    batch = (jax.device_put(x, NamedSharding(mesh, P())),
+             jax.device_put(target, NamedSharding(mesh, P())))
+
+    first = None
+    for i in range(args.steps):
+        stage_params, opt_state, loss = step(stage_params, opt_state, batch)
+        if first is None:
+            first = float(loss)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.5f}", flush=True)
+    print(f"loss {first:.5f} -> {float(loss):.5f} over {args.stages} stages",
+          flush=True)
+    assert float(loss) < first, "pipeline training did not reduce the loss"
+
+
+if __name__ == "__main__":
+    main()

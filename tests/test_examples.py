@@ -129,3 +129,10 @@ def test_jax_mnist_estimator(tmp_path):
         ["--train-steps", "4", "--eval-every", "2", "--batch-per-chip", "4",
          "--ckpt-dir", str(tmp_path)],
     )
+
+
+def test_pipeline_mlp_example():
+    run_example(
+        "pipeline_mlp.py",
+        ["--stages", "4", "--microbatches", "4", "--steps", "12"],
+    )
