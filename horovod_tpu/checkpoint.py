@@ -23,15 +23,40 @@ import jax
 from horovod_tpu import basics
 from horovod_tpu.optim.distributed_optimizer import (
     DistributedOptimizer,
+    _root_process,
+    allgather_object,
     broadcast_object,
     broadcast_parameters,
 )
 
 
-def _ckpt(path: str):
+def _mp_options(solo: bool):
+    """orbax MultiprocessingOptions for a rank-0-only call.
+
+    orbax's Checkpointer.save/restore contract is "called by all hosts" —
+    it runs cross-process sync barriers internally.  The reference's
+    convention is rank-0-ONLY writes, so the root-only code paths must
+    scope those barriers to the calling process (``active_processes``),
+    or rank 0 joins a global barrier its peers never reach and the next
+    collective on every peer pairs with the wrong message.
+    """
     import orbax.checkpoint as ocp
 
-    return ocp.PyTreeCheckpointer(), os.path.abspath(path)
+    if not solo or jax.process_count() == 1:
+        return ocp.options.MultiprocessingOptions()
+    me = jax.process_index()
+    return ocp.options.MultiprocessingOptions(
+        primary_host=me, active_processes={me}
+    )
+
+
+def _make_ckpt(*, solo: bool):
+    import orbax.checkpoint as ocp
+
+    return ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler(),
+        multiprocessing_options=_mp_options(solo),
+    )
 
 
 _async_checkpointer = None
@@ -42,8 +67,11 @@ def _async_ckpt():
     if _async_checkpointer is None:
         import orbax.checkpoint as ocp
 
+        # Only the root process saves (save_checkpoint returns early
+        # elsewhere), so the async writer is self-scoped too.
         _async_checkpointer = ocp.AsyncCheckpointer(
-            ocp.PyTreeCheckpointHandler()
+            ocp.PyTreeCheckpointHandler(),
+            multiprocessing_options=_mp_options(True),
         )
     return _async_checkpointer
 
@@ -69,9 +97,7 @@ def save_checkpoint(
     if async_save:
         _async_ckpt().save(target, jax.device_get(state), force=True)
         return target
-    checkpointer, _ = _ckpt(path)
-    state = jax.device_get(state)
-    checkpointer.save(target, state, force=True)
+    _make_ckpt(solo=True).save(target, jax.device_get(state), force=True)
     return target
 
 
@@ -100,16 +126,44 @@ def latest_checkpoint(path: str) -> str | None:
 def restore_checkpoint(path: str, template: Any = None, *, root_rank: int = 0) -> Any:
     """Load on root, broadcast to every process, re-place on the mesh — the
     reference's load-then-``broadcast_parameters`` resume recipe
-    (pytorch_imagenet_resnet50.py:134-142) as one call."""
+    (pytorch_imagenet_resnet50.py:134-142) as one call.
+
+    With a ``template``, only the ROOT process reads the file: rank-0-only
+    writes mean non-root hosts may not have the checkpoint on their local
+    disk at all; they contribute the template's values and the broadcast
+    overwrites them with root's.  Without a template every process reads
+    (requires a shared filesystem) — the broadcast then guarantees
+    bit-identity even across racy reads.
+    """
     basics._require_init()
-    checkpointer, base = _ckpt(path)
-    # Every process restores the same file set (orbax handles distributed
-    # reads); the broadcast then guarantees bit-identity across hosts.
-    state = (
-        checkpointer.restore(base, item=template)
-        if template is not None
-        else checkpointer.restore(base)
-    )
+    base = os.path.abspath(path)
+    on_root = basics.cross_rank() == _root_process(root_rank)
+    state, err = template, None
+    try:
+        if template is not None and not on_root:
+            pass                      # root-only read; broadcast fills values
+        elif template is not None:
+            # Root-only read: scope orbax's barriers to this process.
+            state = _make_ckpt(solo=True).restore(base, item=template)
+        else:
+            # Every process reads together (shared FS): orbax's global
+            # barriers are consistent because all ranks make the same call.
+            state = _make_ckpt(solo=False).restore(base)
+    except Exception as e:
+        err = f"process {basics.cross_rank()}: {type(e).__name__}: {e}"
+    # Agree on the outcome BEFORE the value broadcast: a read failure on
+    # any process must fail EVERY rank with the same error — otherwise the
+    # failed rank never joins broadcast_parameters and the others hang in
+    # a collective it will never enter.  allgather_object rides the engine
+    # queue, so this cannot misorder against in-flight traffic either.
+    if jax.process_count() > 1:
+        bad = [e for e in allgather_object(err) if e]
+        if bad:
+            raise RuntimeError(
+                "checkpoint restore failed: " + "; ".join(bad)
+            )
+    elif err:
+        raise RuntimeError("checkpoint restore failed: " + err)
     return broadcast_parameters(state, root_rank)
 
 

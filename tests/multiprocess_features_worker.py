@@ -56,6 +56,34 @@ def main() -> None:
     local = np.asarray(jax.device_get(ad)).reshape(-1)[:3]
     assert np.allclose(local, [1.0, 2.0, 3.0], atol=1e-5), local
 
+    # --- restore_checkpoint with a template reads on ROOT only: rank 0
+    # saves to a dir the other ranks pretend not to have (they pass a
+    # nonexistent path), proving the rank-0-local-disk resume works.
+    import tempfile
+
+    import jax.numpy as jnp
+
+    state = {"w": jnp.full((4,), 7.0 + me), "step": jnp.asarray(3 + me)}
+    ckdir = os.environ.get("FEATURES_CKPT_DIR") or tempfile.mkdtemp()
+    if me == 0:
+        hvd.save_checkpoint(ckdir, state)
+    # Barrier through the engine so the save is durable before reads.
+    hvd.allreduce(hvd.from_per_rank(
+        [np.zeros((1,), np.float32)] * n), name="ck.barrier")
+    path = ckdir if me == 0 else os.path.join(ckdir, "definitely-missing")
+    restored = hvd.restore_checkpoint(path, template=state)
+    rw = np.asarray(jax.device_get(restored["w"]))
+    assert np.allclose(rw, 7.0), (me, rw)        # rank 0's values everywhere
+    assert int(np.asarray(jax.device_get(restored["step"]))) == 3, restored
+
+    # A ROOT-side read failure must fail every rank with the same error —
+    # not strand peers in a broadcast the root never joins.
+    try:
+        hvd.restore_checkpoint(os.path.join(ckdir, "nope"), template=state)
+        raise AssertionError("restore of a missing checkpoint succeeded")
+    except RuntimeError as e:
+        assert "checkpoint restore failed" in str(e), e
+
     hvd.shutdown()
     print("WORKER_OK " + json.dumps({"rank": me, "size": n}), flush=True)
 

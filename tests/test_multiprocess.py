@@ -96,15 +96,17 @@ def test_two_process_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
-def test_three_process_process_sets_and_adasum():
-    """ProcessSet subset reductions and the Adasum tree with REAL process
-    boundaries inside and outside the member set (3 workers, 1 CPU device
-    each, native TCP controller)."""
+def test_three_process_process_sets_and_adasum(tmp_path):
+    """ProcessSet subset reductions, the Adasum tree, and root-only-read
+    checkpoint restore with REAL process boundaries inside and outside
+    the member set (3 workers, 1 CPU device each, native TCP
+    controller)."""
     outs = _run_workers(
         os.path.join(HERE, "multiprocess_features_worker.py"), 3,
         {
             "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
             "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+            "FEATURES_CKPT_DIR": str(tmp_path / "feat_ck"),
         },
     )
     for i, out in enumerate(outs):
