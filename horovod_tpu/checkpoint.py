@@ -90,7 +90,12 @@ def save_checkpoint(
     before reading the file or exiting.
     """
     basics._require_init()
-    if basics.cross_rank() != 0:
+    # "Rank 0" means the process owning mesh device 0 — the same
+    # definition restore_checkpoint's reader uses (_root_process); mesh
+    # device order is not guaranteed process-contiguous, and a writer /
+    # reader living on different hosts would lose every checkpoint on
+    # per-host disks.
+    if basics.cross_rank() != _root_process(0):
         return None
     base = os.path.abspath(path)
     target = os.path.join(base, f"step_{step}") if step is not None else base
@@ -112,7 +117,7 @@ def latest_checkpoint(path: str) -> str | None:
     of reference keras_imagenet_resnet50.py:66-70), agreed across hosts."""
     basics._require_init()
     found = None
-    if basics.cross_rank() == 0 and os.path.isdir(path):
+    if basics.cross_rank() == _root_process(0) and os.path.isdir(path):
         steps = []
         for entry in os.listdir(path):
             m = re.fullmatch(r"step_(\d+)", entry)
@@ -139,18 +144,35 @@ def restore_checkpoint(path: str, template: Any = None, *, root_rank: int = 0) -
     base = os.path.abspath(path)
     on_root = basics.cross_rank() == _root_process(root_rank)
     state, err = template, None
-    try:
-        if template is not None and not on_root:
-            pass                      # root-only read; broadcast fills values
-        elif template is not None:
-            # Root-only read: scope orbax's barriers to this process.
-            state = _make_ckpt(solo=True).restore(base, item=template)
-        else:
-            # Every process reads together (shared FS): orbax's global
-            # barriers are consistent because all ranks make the same call.
-            state = _make_ckpt(solo=False).restore(base)
-    except Exception as e:
-        err = f"process {basics.cross_rank()}: {type(e).__name__}: {e}"
+    if template is not None and any(
+        isinstance(l, jax.Array) and not l.is_fully_addressable
+        for l in jax.tree.leaves(template)
+    ):
+        # The broadcast path returns REPLICATED state; a template whose
+        # leaves span non-addressable devices (live sharded train state)
+        # can't ride it — and would crash only on non-root ranks, deep in
+        # the broadcast, stranding the root in the collective.  Fail fast
+        # and identically on every rank instead (this check is
+        # deterministic across ranks, so no agreement round is needed).
+        err = (
+            f"process {basics.cross_rank()}: template leaves span "
+            "non-addressable devices; pass a host/abstract template "
+            "(shapes+dtypes) and re-shard the result, or restore with "
+            "sharding-aware orbax directly"
+        )
+    if err is None:
+        try:
+            if template is not None and not on_root:
+                pass                  # root-only read; broadcast fills values
+            elif template is not None:
+                # Root-only read: scope orbax's barriers to this process.
+                state = _make_ckpt(solo=True).restore(base, item=template)
+            else:
+                # Every process reads together (shared FS): orbax's global
+                # barriers are consistent — all ranks make the same call.
+                state = _make_ckpt(solo=False).restore(base)
+        except Exception as e:
+            err = f"process {basics.cross_rank()}: {type(e).__name__}: {e}"
     # Agree on the outcome BEFORE the value broadcast: a read failure on
     # any process must fail EVERY rank with the same error — otherwise the
     # failed rank never joins broadcast_parameters and the others hang in

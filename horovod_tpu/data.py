@@ -120,23 +120,46 @@ class ShardedLoader:
 
     def _batches(self) -> Iterator[Any]:
         size = basics.size()
-        shards = [
-            shard_indices(
+        steps = len(self)
+        b = self.batch_per_rank
+        sharding = basics.rank_sharding() if self.device_put else None
+        multi = self.device_put and jax.process_count() > 1
+        if multi:
+            # Each process assembles ONLY its own ranks' rows (in mesh
+            # device order) and contributes them as its local shards —
+            # never a host-global array: device_put of a host value onto a
+            # cross-process sharding both copies the whole batch on every
+            # host and runs a per-batch cross-host equality collective,
+            # which can misorder against in-flight engine traffic.
+            me = jax.process_index()
+            ranks = [r for r, d in enumerate(basics.mesh().devices.flat)
+                     if d.process_index == me]
+        else:
+            ranks = list(range(size))
+        # Index shards only for the ranks this process actually feeds —
+        # each shard_indices call is a full O(n) permutation, and on a big
+        # pod computing all `size` of them per host per epoch is size×
+        # the necessary work.
+        shards = {
+            r: shard_indices(
                 self._n, r, size,
                 shuffle=self.shuffle, seed=self.seed, epoch=self.epoch,
                 drop_last=self.drop_last,
             )
-            for r in range(size)
-        ]
-        steps = len(self)
-        b = self.batch_per_rank
-        sharding = basics.rank_sharding() if self.device_put else None
+            for r in ranks
+        }
         for s in range(steps):
             # Rank-major assembly: rank i's slice is rows [i*b, (i+1)*b).
-            idx = np.concatenate([shard[s * b:(s + 1) * b] for shard in shards])
+            idx = np.concatenate(
+                [shards[r][s * b:(s + 1) * b] for r in ranks]
+            )
 
             def take(leaf):
                 out = leaf[idx]
+                if multi:
+                    return jax.make_array_from_process_local_data(
+                        sharding, out
+                    )
                 return jax.device_put(out, sharding) if sharding else out
 
             yield jax.tree.map(take, self.data)

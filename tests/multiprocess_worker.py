@@ -122,6 +122,26 @@ def main() -> None:
         got = np.asarray(jax.device_get(o)).reshape(-1, 5)
         assert np.allclose(got, want), (i, got, want)
 
+    # --- ShardedLoader in a multi-process world: each process assembles
+    # only ITS ranks' rows (process-local shards, no cross-host device_put
+    # of a global batch); the assembled array must still be the full
+    # rank-major batch with the DistributedSampler shard per rank.
+    from horovod_tpu.data import ShardedLoader, shard_indices
+
+    ds_x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    loader = ShardedLoader(
+        {"x": ds_x}, batch_per_rank=3, shuffle=True, seed=5, prefetch=1
+    )
+    loader.set_epoch(2)
+    batches = list(loader)
+    assert len(batches) == len(loader) > 0
+    first = batches[0]["x"]
+    assert first.shape == (n * 3, 2), first.shape
+    my_rows = np.asarray(first.addressable_shards[0].data)
+    want_idx = shard_indices(20, me, n, shuffle=True, seed=5, epoch=2,
+                             drop_last=True)[:3]
+    assert np.allclose(my_rows, ds_x[want_idx]), (me, my_rows)
+
     hvd.shutdown()
 
     # --- per-rank NEGOTIATE ticks (reference timeline.cc:98-132): rank 0's
