@@ -85,9 +85,10 @@ def multiplier_schedule(
             m = multiplier(epoch)
         else:
             m = multiplier
-        in_window = (epoch >= start_epoch) & (
-            (end_epoch is None) | (epoch < (end_epoch or math.inf))
-        )
+        # `is None`, not truthiness: end_epoch=0 is a real (empty) window,
+        # and `0 or inf` would silently unbound it.
+        end = math.inf if end_epoch is None else end_epoch
+        in_window = (epoch >= start_epoch) & (epoch < end)
         return jnp.where(in_window, base_lr * m, base_lr)
 
     return schedule
