@@ -1,0 +1,98 @@
+"""Staged TPU bring-up probe — find exactly where device init or the first
+training step stalls.
+
+Usage (ALWAYS under an external bound: a hung claim is only killable from
+outside — see docs/troubleshooting.md "Tunnel claim mechanics"):
+
+    timeout 300 python tools/tpu_bringup_probe.py
+
+Each stage prints a ``[+Ns]`` note; ``faulthandler.dump_traceback_later``
+dumps every thread's Python stack and exits if any single run exceeds
+``STAGE_TIMEOUT`` seconds (default 120), so a hang names its stage AND its
+frame.  Diagnoses observed in the field:
+
+* stuck in ``make_c_api_client`` at the first jax call → the pool has no
+  grantable chip (tunnel down or claim held elsewhere).  Nothing in this
+  process will unstick it; retry later.
+* stuck in ``block_until_ready`` after "compile done" → the tunnel died
+  mid-run; the device future will never resolve.
+* slow-but-moving compiles with low local CPU → remote compile is doing the
+  work; be patient or shrink the model.
+"""
+
+import faulthandler
+import os
+import sys
+import time
+
+_STAGE_TIMEOUT = int(os.environ.get("STAGE_TIMEOUT", "120"))
+faulthandler.dump_traceback_later(_STAGE_TIMEOUT, exit=True)
+
+t0 = time.monotonic()
+
+
+def note(msg):
+    print(f"[+{time.monotonic() - t0:.1f}s] {msg}", file=sys.stderr, flush=True)
+    # Re-arm at every stage boundary so the bound is per-STAGE, as the
+    # name promises — a slow-but-healthy bring-up (remote compiles) must
+    # not be force-exited just because the stages add up past one window.
+    faulthandler.dump_traceback_later(_STAGE_TIMEOUT, exit=True)
+
+
+import jax
+import jax.numpy as jnp
+
+note(f"jax imported; initializing backend (the claim happens HERE)")
+note(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+import optax
+
+import horovod_tpu as hvd
+
+note("horovod_tpu imported")
+hvd.init()
+note(f"hvd.init done, size={hvd.size()}")
+
+import horovod_tpu.models.resnet as resnet_mod
+
+kimg, klab = jax.random.split(jax.random.key(7))
+images = jax.random.normal(kimg, (8, 64, 64, 3), jnp.float32)
+labels = jax.random.randint(klab, (8,), 0, 1000, jnp.int32)
+jax.block_until_ready(images)
+note("synthetic data on device")
+
+model = resnet_mod.ResNet50(dtype=jnp.bfloat16)
+variables = model.init(jax.random.key(0), images[:1], train=False)
+jax.block_until_ready(variables)
+note("model.init done")
+params, batch_stats = variables["params"], variables["batch_stats"]
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    logits, _ = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        x, train=True, mutable=["batch_stats"],
+    )
+    onehot = jax.nn.one_hot(y, logits.shape[-1])
+    return optax.softmax_cross_entropy(logits, onehot).mean()
+
+
+tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+opt_state = tx.init(params)
+jax.block_until_ready(opt_state)
+note("opt init done")
+
+step = hvd.make_train_step(loss_fn, tx, donate=True)
+lowered = step.lower(params, opt_state, (images, labels))
+note("lower done")
+compiled = lowered.compile()
+note("compile done")
+out = compiled(params, opt_state, (images, labels))
+jax.block_until_ready(out)
+note("first step done")
+t1 = time.perf_counter()
+for _ in range(5):
+    out = compiled(out.params, out.opt_state, (images, labels))
+jax.block_until_ready(out)
+note(f"5 steps in {time.perf_counter() - t1:.3f}s — bring-up healthy")
