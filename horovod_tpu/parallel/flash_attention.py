@@ -54,13 +54,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     # Skip blocks entirely above the causal diagonal (no MXU work there).
     @pl.when((not causal) or (k_start <= q_start + block_q - 1))
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [bq, D]
-        k = k_ref[0].astype(jnp.float32)            # [bk, D]
-        v = v_ref[0].astype(jnp.float32)            # [bk, D]
+        # Operands stay in their storage dtype (bf16 in training): the MXU
+        # runs bf16×bf16→f32 at full rate, while upcasting operands first
+        # would force f32×f32 matmuls at a fraction of peak.  All
+        # accumulation below is f32 via preferred_element_type / scratch.
+        q = q_ref[0]                                 # [bq, D]
+        k = k_ref[0]                                 # [bk, D]
+        v = v_ref[0]                                 # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                    # [bq, bk]
+        ) * scale                                    # [bq, bk] f32
         qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = kpos < seq_len                        # padded tail keys
@@ -74,8 +78,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         corr = jnp.exp(m_prev - m_new)               # [bq, 1]
         l_ref[:, 0:1] = l_ref[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
         m_ref[:, 0:1] = m_new
+        # p rides the MXU in the storage dtype (standard flash practice —
+        # the f32 row-sum/max state above carries the precision).
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -167,10 +173,12 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when((not causal) or (k_start <= q_start + block_q - 1))
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Storage-dtype operands on the MXU, f32 accumulation — see the
+        # forward kernel's note.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         mask = _mask_scores(causal, q_start, k_start, block_q, block_k, seq_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -184,7 +192,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )
         ds = p * (dp - delta_ref[0]) * scale
         acc_ref[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -209,10 +217,12 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # Skip q blocks entirely above the causal diagonal (p would be all 0).
     @pl.when((not causal) or (q_start + block_q - 1 >= k_start))
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Storage-dtype operands on the MXU, f32 accumulation — see the
+        # forward kernel's note.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         mask = _mask_scores(causal, q_start, k_start, block_q, block_k, seq_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -228,11 +238,11 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # Contract the query (sublane) dim of both operands — dK/dV tiles
         # accumulate without any materialized transpose.
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -403,6 +413,15 @@ def flash_attention(
     bwd_impl = (bwd or os.environ.get("HVD_TPU_FLASH_BWD", "pallas")).lower()
     if bwd_impl not in ("pallas", "blockwise"):
         raise ValueError(f"bwd must be 'pallas' or 'blockwise', got {bwd!r}")
+    if not (q.dtype == k.dtype == v.dtype):
+        # The kernels run matmuls on the operands' storage dtype (full-rate
+        # bf16 MXU); mixed inputs would otherwise die deep inside a
+        # dot_general trace.  Cast at the call site — typically the KV
+        # cache's dtype is the one to keep.
+        raise ValueError(
+            f"flash_attention requires q/k/v of one dtype, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}"
+        )
     b, l, h, d = q.shape
     kvh = k.shape[2]
     block_q = min(block_q, max(l, 1))

@@ -154,6 +154,50 @@ def test_flash_gradient_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+def test_flash_bf16_matches_f32_reference():
+    """bf16 inputs take the storage-dtype MXU path (bf16 operands, f32
+    accumulation, p/ds downcast before the second matmul) — the f32 tests
+    above cast nothing, so this is the only coverage of those casts."""
+    q, k, v = _qkv(b=1, l=40, h=2, kvh=1, d=16, seed=5)
+    ref = dense_attention(q, k, v, causal=True)
+    out = flash_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16), causal=True, block_q=16, block_k=16,
+    )
+    assert out.dtype == jnp.bfloat16
+    # bf16 has ~8 mantissa bits; values are O(1) post-softmax.
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_flash_bf16_gradients_match_f32_reference():
+    q, k, v = _qkv(b=1, l=24, h=2, kvh=2, d=8, seed=6)
+
+    def loss_f(q, k, v):
+        out = flash_attention(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16), causal=True, block_q=8, block_k=8,
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=8e-2, rtol=5e-2
+        )
+
+
+def test_flash_rejects_mixed_dtypes():
+    q, k, v = _qkv(b=1, l=16, h=2, kvh=1, d=8)
+    with pytest.raises(ValueError, match="one dtype"):
+        flash_attention(q, k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_pallas_bwd_matches_blockwise_oracle(causal, monkeypatch):
     """The two-pass pallas backward == the blockwise-recompute oracle,
