@@ -262,19 +262,38 @@ class EagerEngine:
         """Analogue of EnqueueTensorAllreduce/Allgather/Broadcast
         (reference operations.cc:2099-2215): push into the shared queue under
         the table mutex; the cycle thread picks it up."""
-        pending.enqueued_at = time.monotonic()
-        if self.timeline:
-            self.timeline.start(pending.name, timeline_mod.NEGOTIATE + "_" + pending.kind.upper())
-            if self.controller is None:
-                # Single controller: one thread observes every enqueue, so
-                # all ranks' readiness arrives at once — one tick covers the
-                # reference's per-rank tick events (timeline.cc:98-132).
-                self.timeline.instant(pending.name, "NEGOTIATE_TICK_ALL")
+        self.enqueue_many([pending])
+        return pending.handle
+
+    def enqueue_many(self, pendings: list[_PendingOp]) -> None:
+        """Enqueue a caller-delimited group ATOMICALLY (one lock
+        acquisition), so no cycle-thread flush can observe a partial group.
+
+        This is what makes grouped fusion deterministic: with the whole
+        group entering the queue at once and ``_fuse_key`` isolating it by
+        ``group_id``, every flush sees the same bucket composition for the
+        same call — and therefore the same jitted-program signatures.
+        Per-op enqueue would let the tick cut the group at a wall-clock-
+        dependent point, compiling a fresh program arity per cut (compile
+        churn measured at ~240 ms per novel signature on the CPU sim).
+        """
+        now = time.monotonic()
+        for p in pendings:
+            p.enqueued_at = now
+            if self.timeline:
+                self.timeline.start(
+                    p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
+                )
+                if self.controller is None:
+                    # Single controller: one thread observes every enqueue,
+                    # so all ranks' readiness arrives at once — one tick
+                    # covers the reference's per-rank tick events
+                    # (timeline.cc:98-132).
+                    self.timeline.instant(p.name, "NEGOTIATE_TICK_ALL")
         with self._lock:
             if self._shutdown.is_set():
                 raise RuntimeError("horovod_tpu engine has been shut down")
-            self._queue.append(pending)
-        return pending.handle
+            self._queue.extend(pendings)
 
     def _fuse_key(self, p: _PendingOp):
         """Fusability key for :func:`fusion.plan_buckets` — the eager
@@ -297,10 +316,18 @@ class EagerEngine:
             return ("solo", p.handle)
         ps = p.process_set.ranks if p.process_set is not None else None
         base = ("ar", p.op.name, p.compression, str(p.tensor.dtype), ps)
+        if p.group_id is not None:
+            # Caller-delimited groups are isolated whenever fusion is
+            # planned HERE (single host, or multi-host without the native
+            # controller — the controller path negotiates its own merge,
+            # see _controller_group): members enter the queue atomically
+            # (enqueue_many), so bucket composition — and with it the
+            # jitted dispatch-program signature — is identical on every
+            # call instead of varying with where the cycle tick happened
+            # to cut the queue.
+            return base + (("grp", p.group_id),)
         if jax.process_count() > 1:
-            return base + (
-                ("grp", p.group_id) if p.group_id is not None else ("solo", p.handle),
-            )
+            return base + (("solo", p.handle),)
         return base
 
     def flush(self) -> None:
@@ -809,26 +836,35 @@ def allreduce_async(
     unchanged (Horovod ≥0.22 API).  ``no_fuse=True`` keeps this op out of
     every fusion bucket (for callers whose local math must reproduce the
     wire's per-tensor form exactly, e.g. int8 error feedback)."""
+    eng, pending = _prepare_allreduce(
+        tensor, average, name, op=op, compression=compression,
+        group_id=group_id, process_set=process_set, no_fuse=no_fuse,
+    )
+    eng.enqueue(pending)
+    return pending.handle
+
+
+def _prepare_allreduce(tensor, average, name, *, op, compression, group_id,
+                       process_set, no_fuse):
+    """Build (engine, ready-to-enqueue _PendingOp) — shared by the per-op
+    async path and the atomic grouped path."""
     if average is not None:
         op = Average if average else Sum
     eng = _engine()
     t = _as_rank_major(tensor, "allreduce")
     name = name or _auto_name("allreduce")
     h = eng.handles.allocate(name)
-    eng.enqueue(
-        _PendingOp(
-            kind="allreduce",
-            handle=h,
-            tensor=t,
-            name=name,
-            op=op,
-            compression=compression,
-            group_id=group_id,
-            process_set=process_set,
-            no_fuse=no_fuse,
-        )
+    return eng, _PendingOp(
+        kind="allreduce",
+        handle=h,
+        tensor=t,
+        name=name,
+        op=op,
+        compression=compression,
+        group_id=group_id,
+        process_set=process_set,
+        no_fuse=no_fuse,
     )
-    return h
 
 
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
@@ -1056,22 +1092,29 @@ def grouped_allreduce_eager(
     buckets (the reference achieves this implicitly when many grads arrive in
     one cycle — test/test_torch.py:175-224 ``..._async_fused``).
 
-    The call delimits a fusion group, so fusion stays deterministic across
-    hosts in multi-controller jobs (see ``EagerEngine._fuse_key``)."""
+    The call delimits a fusion group: members enter the engine queue
+    atomically and, under Python-planned fusion (single host or
+    controller-less multi-host), fuse only with each other
+    (``EagerEngine._fuse_key``) — bucket composition and the compiled
+    dispatch-program signatures are then deterministic for a given call
+    shape, across hosts AND across repeated calls (no cycle-tick-dependent
+    compile churn).  The native-controller path instead merges by
+    negotiated fusability (globally consistent, timing-dependent —
+    docs/tensor-fusion.md "Determinism and compile churn")."""
     if names is not None and len(names) != len(tensors):
         raise ValueError(
             f"names has {len(names)} entries for {len(tensors)} tensors"
         )
     gid = next(_group_counter)
-    handles = [
-        allreduce_async(
-            t,
-            average,
-            (names[i] if names else None),
-            op=op,
-            compression=compression,
-            group_id=gid,
+    eng = None
+    pendings = []
+    for i, t in enumerate(tensors):
+        eng, p = _prepare_allreduce(
+            t, average, (names[i] if names else None),
+            op=op, compression=compression, group_id=gid,
+            process_set=None, no_fuse=False,
         )
-        for i, t in enumerate(tensors)
-    ]
-    return [synchronize(h) for h in handles]
+        pendings.append(p)
+    if eng is not None:
+        eng.enqueue_many(pendings)
+    return [synchronize(p.handle) for p in pendings]

@@ -40,6 +40,36 @@ def test_plan_buckets_fusion_disabled():
     assert plan == [[0], [1], [2]]
 
 
+def test_grouped_buckets_deterministic_across_calls():
+    """Repeated grouped_allreduce_eager calls must dispatch identical
+    bucket compositions: composition drives the jitted dispatch-program
+    signature, and a cycle-tick-dependent cut would compile a fresh XLA
+    program per call (~240 ms each — measured before group enqueue became
+    atomic and group-isolated in _fuse_key)."""
+    from horovod_tpu.ops.eager import EagerEngine
+
+    grads = [jnp.ones((8, 256)) * i for i in range(12)]
+    seen = []
+    orig = EagerEngine._dispatch_allreduce_group
+
+    def record(self, group):
+        seen.append(tuple(p.tensor.shape for p in group))
+        return orig(self, group)
+
+    EagerEngine._dispatch_allreduce_group = record
+    try:
+        hvd.grouped_allreduce_eager(grads, average=True)
+        first = sorted(seen)
+        for _ in range(4):
+            seen.clear()
+            hvd.grouped_allreduce_eager(grads, average=True)
+            assert sorted(seen) == first, (
+                "bucket composition varied across identical grouped calls"
+            )
+    finally:
+        EagerEngine._dispatch_allreduce_group = orig
+
+
 def test_fused_apply_identity_preserves_values():
     ts = [jnp.arange(5.0), jnp.ones((2, 3)), jnp.arange(4.0).reshape(2, 2)]
     outs = fusion.fused_apply(ts, lambda flat: flat * 2.0)
