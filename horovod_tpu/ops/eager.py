@@ -396,9 +396,20 @@ class EagerEngine:
         The id must be a pure function of the key — NOT encounter order,
         which differs across ranks when flush timing differs, and would let
         the controller fuse a Sum with a Min (dispatched with group[0]'s op
-        → silently wrong numerics).  Caller-delimited group ids are not
-        included: with true negotiation the batch order is globally agreed,
-        so cross-group merging is safe."""
+        → silently wrong numerics).
+
+        Caller-delimited group ids ARE included: cross-group merging would
+        be *correct* (the batch order is globally agreed), but it makes
+        bucket composition depend on what other traffic shared the
+        negotiation tick — and under XLA every novel composition is a
+        fresh compiled dispatch program (docs/tensor-fusion.md
+        "Determinism and compile churn").  Group ids come from a
+        per-process counter, identical across ranks exactly when the user
+        program is — the same contract grouped fusion already relies on in
+        the controller-less multi-host mode.  A divergent program cannot
+        deadlock on it: the first-arriving rank's token wins at the
+        coordinator and the batch it broadcasts is what every rank
+        dispatches."""
         if p.kind != "allreduce":
             return -1
         comp = getattr(p.compression, "__name__", None) or type(
@@ -406,6 +417,8 @@ class EagerEngine:
         ).__name__
         ps = p.process_set.ranks if p.process_set is not None else ()
         token = f"{p.op.name}:{comp}:{ps}".encode()
+        if p.group_id is not None:
+            token += b":grp:" + str(p.group_id).encode()
         if p.no_fuse:
             # Only the same-named request from the other ranks may join
             # this batch — names are identical across ranks, so the batch

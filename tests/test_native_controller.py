@@ -349,6 +349,58 @@ def test_eager_engine_native_fused_group(native_engine_world):
         np.testing.assert_allclose(np.asarray(o), np.full(4, 3.5 + i))
 
 
+def test_eager_engine_native_grouped_composition_deterministic(
+    native_engine_world,
+):
+    """Caller-delimited groups ride their own negotiation token, so (a)
+    concurrent solo traffic never lands in the group's batch and (b)
+    repeated identical grouped calls dispatch identical bucket
+    compositions — novel compositions are fresh XLA compiles
+    (docs/tensor-fusion.md "Determinism and compile churn")."""
+    from horovod_tpu.basics import _state
+    from horovod_tpu.ops.eager import EagerEngine
+
+    grads = [hvd.per_rank(lambda r, i=i: jnp.full((16,), float(i)))
+             for i in range(6)]
+    seen = []
+    orig = EagerEngine._dispatch_allreduce_group
+
+    def record(self, group):
+        seen.append(sorted(p.name for p in group))
+        return orig(self, group)
+
+    EagerEngine._dispatch_allreduce_group = record
+    try:
+        solo = hvd.allreduce_async(
+            hvd.per_rank(lambda r: jnp.ones((16,))), name="solo.bystander"
+        )
+        assert _state.engine.controller is not None  # engine exists now
+        first_outs = hvd.grouped_allreduce_eager(
+            grads, average=True, names=[f"det.g{i}" for i in range(6)]
+        )
+        hvd.synchronize(solo)
+        group_batches = [g for g in seen if any(n.startswith("det.") for n in g)]
+        assert group_batches, "grouped call never dispatched"
+        for g in group_batches:   # (a) isolation from the bystander
+            assert "solo.bystander" not in g
+        for trial in range(3):    # (b) stable composition call-to-call
+            seen.clear()
+            outs = hvd.grouped_allreduce_eager(
+                grads, average=True,
+                names=[f"det{trial}.g{i}" for i in range(6)],
+            )
+            trial_batches = [
+                [n.split(".", 1)[1] for n in g]
+                for g in seen if any(n.startswith(f"det{trial}.") for n in g)
+            ]
+            want = [[n.split(".", 1)[1] for n in g] for g in group_batches]
+            assert trial_batches == want
+        for i, o in enumerate(first_outs):
+            np.testing.assert_allclose(np.asarray(o), np.full(16, float(i)))
+    finally:
+        EagerEngine._dispatch_allreduce_group = orig
+
+
 def test_eager_engine_duplicate_name_errors(native_engine_world):
     x = hvd.per_rank(lambda r: jnp.ones((2,)))
     h1 = hvd.allreduce_async(x, name="dup")
