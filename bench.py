@@ -131,7 +131,12 @@ def _aot_compile(step, *args):
             # retry through the direct path would die on deleted arrays,
             # masking the real failure (OOM, collective error, ...).
             out = compiled(*args)       # validation + warmup in one call
-            jax.block_until_ready(out)
+            # Real fence: warmup must not bleed into the first timed group
+            # (block_until_ready can ack before remote execution completes
+            # — see _readback).  One program's outputs all materialize at
+            # its completion, so the smallest leaf's bytes arriving proves
+            # the program ran without hauling a param tensor host-side.
+            _readback(min(jax.tree.leaves(out), key=lambda l: l.size))
             flops = None
             try:
                 ca = compiled.cost_analysis()
@@ -142,8 +147,28 @@ def _aot_compile(step, *args):
                 pass
             return compiled, flops, out
     out = step(*args)
-    jax.block_until_ready(out)
+    # Same real fence as the compiled path: the direct-call fallback can
+    # execute on the relay too (e.g. .lower() raising on an exotic step).
+    _readback(min(jax.tree.leaves(out), key=lambda l: l.size))
     return step, None, out
+
+
+def _measure_rtt_ms() -> float:
+    """Median dispatch+readback latency of a trivial op — the tunnel's
+    round-trip floor.  Recorded in extras so the artifact self-documents
+    how much of each timed group is relay latency rather than compute."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8, 128), jnp.float32)
+    _readback(f(x))                      # warm the compile cache
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _readback(f(x))
+        samples.append(time.perf_counter() - t0)
+    return round(sorted(samples)[len(samples) // 2] * 1e3, 1)
 
 
 def _mfu(flops_per_step_per_chip: float | None,
@@ -154,16 +179,35 @@ def _mfu(flops_per_step_per_chip: float | None,
     return flops_per_step_per_chip * steps_per_sec / peak
 
 
-def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
-    """Mean steps/sec over ``num_iters`` groups of ``num_batches`` steps."""
+def _readback(x) -> None:
+    """Force a real device→host round trip on ``x`` (any pytree).
+
+    ``block_until_ready`` is NOT a sufficient fence on this deployment:
+    the chip sits behind a pool relay whose futures for compiled-executable
+    calls complete before remote execution does, so a block-based timing
+    loop measures dispatch, not compute — it produced a "61 MFU" llama
+    number (physically impossible; the chip's measured matmul peak is
+    ~200 TFLOP/s).  A readback of the actual VALUE cannot be acknowledged
+    early: the bytes must arrive.  Costs one tunnel round trip (~82 ms
+    measured) — callers amortize it over a group of steps.
+    """
     import jax
 
+    jax.device_get(x)   # device_get = tree-mapped np.asarray: bytes arrive
+
+
+def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
+    """Mean steps/sec over ``num_iters`` groups of ``num_batches`` steps.
+
+    Each group is fenced by a scalar readback of its final sync value
+    (see ``_readback``); the donation chain serializes the group's steps
+    behind it, so the group's wall-clock covers real execution."""
     rates = []
     for _ in range(num_iters):
         t0 = time.perf_counter()
         for _ in range(num_batches):
             sync = step_once()
-        jax.block_until_ready(sync)
+        _readback(sync)
         rates.append(num_batches / (time.perf_counter() - t0))
     return sum(rates) / len(rates)
 
@@ -187,8 +231,10 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
     # CPU fallback: 3 timed steps (not 1) so the smoke number is stable
     # enough to track regressions round-over-round (judge r2).
     num_iters = int(os.environ.get("HVD_TPU_BENCH_ITERS", "5" if on_tpu else "1"))
+    # Group size amortizes the per-group readback fence (~82 ms tunnel
+    # round trip) below ~10% of a group's wall-clock.
     num_batches = int(
-        os.environ.get("HVD_TPU_BENCH_BATCHES", "10" if on_tpu else "3")
+        os.environ.get("HVD_TPU_BENCH_BATCHES", "20" if on_tpu else "3")
     )
     n = hvd.size()
     model = getattr(resnet_mod, f"ResNet{depth}")(
@@ -207,7 +253,12 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
     )
     labels = jax.random.randint(klab, (global_bs,), 0, 1000, jnp.int32)
 
-    variables = model.init(jax.random.key(0), images[:1], train=False)
+    # Jit the init: unjitted flax init dispatches hundreds of tiny ops,
+    # each a round-trip through the remote-compile tunnel (~2 min measured
+    # for ResNet-50 bring-up on the real chip vs one ~10 s compile jitted).
+    variables = jax.jit(model.init, static_argnames="train")(
+        jax.random.key(0), images[:1], train=False
+    )
     params, batch_stats = variables["params"], variables["batch_stats"]
 
     # Only trainable params are differentiated / allreduced / given momentum;
@@ -223,7 +274,7 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
         return optax.softmax_cross_entropy(logits, onehot).mean()
 
     tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
-    opt_state = tx.init(params)
+    opt_state = jax.jit(tx.init)(params)  # one compile, not a dispatch per leaf
     _note(f"resnet{depth}: inputs+params ready, compiling")
     step, flops, out = _aot_compile(
         # donate: real training reuses the params/opt buffers every step;
@@ -298,7 +349,9 @@ def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
             fused_loss_chunk=(4 * seq if fused_loss else None),
         )
         batch_per_chip = 4
-        iters, batches = (3, 8) if scale == 1 else (1, 1)
+        # 16-step groups keep the ~82 ms per-group readback fence under
+        # ~10% of group wall-clock (same rationale as the resnet arm).
+        iters, batches = (3, 16) if scale == 1 else (1, 1)
     else:
         cfg = llama.llama_tiny(
             attn_impl="flash", fused_loss_chunk=64 if fused_loss else None
@@ -308,7 +361,7 @@ def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
     loss = llama.make_loss_fn(cfg)
     tx = hvd.DistributedOptimizer(optax.adamw(1e-4))
     params = llama.init_params(cfg, jax.random.key(0))
-    opt_state = tx.init(params)
+    opt_state = jax.jit(tx.init)(params)  # one compile, not a dispatch per leaf
 
     tokens = jax.random.randint(
         jax.random.key(11), (batch_per_chip * n, seq), 0,
@@ -375,12 +428,27 @@ def _bench_fusion(hvd, on_tpu: bool) -> dict:
 
     # VGG-16 parameter shapes only (no training) — the fusion workload.
     model = VGG16(num_classes=10)
-    params = model.init(jax.random.key(0), jnp.ones((1, 32, 32, 3)))["params"]
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.ones((1, 32, 32, 3))
+    )["params"]
     leaves = [jnp.asarray(x) for x in jax.tree.leaves(params)]
     n = hvd.size()
     grads = [jnp.broadcast_to(x, (n, *x.shape)) for x in leaves]
+    # 30 rounds amortize the single end-of-arm readback fence (~82 ms) to
+    # ~3 ms/round — a constant added EQUALLY to both arms would compress
+    # the fused/unfused ratio toward 1.
     rounds = int(
-        os.environ.get("HVD_TPU_BENCH_FUSION_ROUNDS", "5" if on_tpu else "2")
+        os.environ.get("HVD_TPU_BENCH_FUSION_ROUNDS", "30" if on_tpu else "2")
+    )
+
+    # One scalar depending on EVERY output of EVERY round: the allreduces
+    # are independent programs, so reading back any subset would let the
+    # relay still be executing the rest (see _readback).  Jitted so each
+    # round adds ONE digest dispatch, not ~2·len(outs); the accumulator
+    # chains the rounds so the single final readback fences all of them.
+    digest = jax.jit(
+        lambda acc, outs:
+        acc + jnp.stack([jnp.sum(o.astype(jnp.float32)) for o in outs]).sum()
     )
 
     def run_config(threshold: str) -> float:
@@ -388,11 +456,14 @@ def _bench_fusion(hvd, on_tpu: bool) -> dict:
         os.environ["HOROVOD_FUSION_THRESHOLD"] = threshold
         os.environ["HOROVOD_CYCLE_TIME"] = "1"
         hvd.init()
-        hvd.grouped_allreduce_eager(grads, average=True)  # warmup/compile
+        outs = hvd.grouped_allreduce_eager(grads, average=True)  # warmup
+        _readback(digest(jnp.float32(0), outs))     # + digest compile
+        acc = jnp.float32(0)
         t0 = time.perf_counter()
         for _ in range(rounds):
             outs = hvd.grouped_allreduce_eager(grads, average=True)
-        jax.block_until_ready(outs)
+            acc = digest(acc, outs)
+        _readback(acc)
         return (time.perf_counter() - t0) / rounds
 
     try:
@@ -466,6 +537,14 @@ def _worker_main(mode: str, status_path: str | None) -> None:
         "n_chips": hvd.size(),
         "resnet101_flops_per_step_per_chip": result["flops_per_step"],
     }
+    if backend != "cpu":
+        # Gate on the REAL backend, not the force-flag-overridden on_tpu:
+        # a CPU rehearsal recording local dispatch latency as "tunnel RTT"
+        # would read as a 100x tunnel speedup round-over-round.
+        try:
+            extras["tunnel_rtt_ms"] = _measure_rtt_ms()
+        except Exception as exc:
+            extras["tunnel_rtt_ms_error"] = f"{type(exc).__name__}: {exc}"
     # A shrunken/forced rehearsal must be unmistakable in the artifact —
     # its numbers share keys with the flagship config and would otherwise
     # read as real in round-over-round comparison.
