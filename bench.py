@@ -212,7 +212,8 @@ def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
     return sum(rates) / len(rates)
 
 
-def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
+def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
+                  batch_per_chip: int | None = None) -> dict:
     """``depth`` selects ResNet-101 (the reference's published-number
     config, the primary metric) or ResNet-50 (BASELINE.json's headline
     metric and the reference's in-repo harness model)."""
@@ -222,9 +223,10 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
 
     import horovod_tpu.models.resnet as resnet_mod
 
-    batch_per_chip = int(
-        os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "2")
-    )
+    if batch_per_chip is None:
+        batch_per_chip = int(
+            os.environ.get("HVD_TPU_BENCH_BS", "64" if on_tpu else "2")
+        )
     image_size = int(
         os.environ.get("HVD_TPU_BENCH_IMG", "224" if on_tpu else "32")
     )
@@ -297,6 +299,29 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101) -> dict:
         "images_per_sec_per_chip": round(per_chip, 2),
         "mfu": _mfu(flops, steps_per_sec),
         "flops_per_step": flops,
+    }
+
+
+def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
+    """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
+    metric keeps the reference's bs-64 config for apples-to-apples, but a
+    v5e fills its MXU better at larger per-chip batch — this arm reports
+    what the chip can actually sustain."""
+    if not on_tpu:
+        return {}
+    big = int(os.environ.get("HVD_TPU_BENCH_BIG_BS", "0"))
+    if not big:
+        if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+            # Rehearsal: scale off the (shrunken) ambient batch so the
+            # arm stays cheap on whatever backend is standing in.
+            big = 2 * int(os.environ.get("HVD_TPU_BENCH_BS", "2"))
+        else:
+            big = 128
+    r = _bench_resnet(hvd, on_tpu, depth=101, batch_per_chip=big)
+    return {
+        f"resnet101_bs{big}_images_per_sec_per_chip":
+            r["images_per_sec_per_chip"],
+        f"resnet101_bs{big}_mfu": r["mfu"],
     }
 
 
@@ -389,13 +414,23 @@ def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
                 steps_per_sec * batch_per_chip * seq, 1
             ),
         }
-    return {
+    out_d = {
         "llama_tokens_per_sec_per_chip": round(
             steps_per_sec * batch_per_chip * seq, 1
         ),
         "llama_mfu": _mfu(flops, steps_per_sec),
         "llama_params": llama.num_params(cfg),
     }
+    # cost_analysis() cannot see inside pallas custom calls, so the flash
+    # kernel's FLOPs are missing from llama_mfu (it UNDERcounts).  Report
+    # the standard analytic 6·N·D transformer estimate alongside it.
+    peak = _peak_flops_per_chip()
+    if peak:
+        tokens_per_step = batch_per_chip * seq
+        out_d["llama_mfu_6nd"] = round(
+            6.0 * llama.num_params(cfg) * tokens_per_step * steps_per_sec
+            / peak, 4)
+    return out_d
 
 
 def _bench_llama_fused(hvd, on_tpu: bool) -> dict:
@@ -490,6 +525,21 @@ def _worker_main(mode: str, status_path: str | None) -> None:
 
     import jax
 
+    # Persistent compilation cache: the first compile of each arm costs
+    # 10-40 s; cached executables survive across worker processes (and
+    # across the round's rehearsals vs the driver's real run on the same
+    # host), so a cache hit buys the budget fence whole extra arms.
+    try:
+        cache_dir = os.environ.get(
+            "HVD_TPU_BENCH_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"),
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass    # older jax without the knob: compiles stay per-process
+
     if mode == "cpu":
         # The env var alone is NOT enough: a pool plugin's sitecustomize
         # registration calls ``jax.config.update("jax_platforms",
@@ -565,7 +615,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # New arms go LAST: under the budget fence, the arms earlier rounds
     # already recorded (llama/fusion) keep priority for comparability.
     for fn in (_bench_llama, _bench_fusion, _bench_llama_fused,
-               _bench_resnet50):
+               _bench_resnet50, _bench_resnet101_big_batch):
         if time.monotonic() - _T_START > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue

@@ -1,0 +1,123 @@
+"""On-chip flash-attention validation: correctness vs the dense oracle and
+an honestly-fenced flash/dense timing A/B.
+
+The pallas kernels' unit tests run under the CPU interpreter
+(tests/test_attention.py); this tool is the real-hardware counterpart —
+run it whenever a chip window opens:
+
+    timeout 600 python tools/tpu_flash_check.py
+
+All timing uses value readbacks, never ``block_until_ready``
+(docs/troubleshooting.md "Tunnel claim mechanics" #4).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import faulthandler
+
+faulthandler.dump_traceback_later(
+    int(os.environ.get("STAGE_TIMEOUT", "240")), exit=True)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+t0 = time.monotonic()
+
+
+def note(msg):
+    print(f"[+{time.monotonic() - t0:.1f}s] {msg}", flush=True)
+    # Re-arm: the bound is per-STAGE, not total — a healthy cold-chip run
+    # (several 10-40 s remote compiles) must not be force-exited just
+    # because the stages add up (same pattern as tpu_bringup_probe.py).
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("STAGE_TIMEOUT", "240")), exit=True)
+
+
+note(f"backend={jax.default_backend()} devices={jax.devices()}")
+if jax.default_backend() == "cpu":
+    sys.exit("needs the real chip; got cpu")
+
+from horovod_tpu.parallel.attention import dense_attention
+from horovod_tpu.parallel.flash_attention import flash_attention
+
+B, L, H, KVH, D = 2, 2048, 8, 2, 64
+ks = jax.random.split(jax.random.key(0), 3)
+q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, L, KVH, D), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, L, KVH, D), jnp.bfloat16)
+
+
+def loss_flash(q, k, v):
+    return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+
+def loss_dense(q, k, v):
+    return jnp.sum(
+        dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+
+# ── correctness: forward + grads, flash (pallas fwd+bwd) vs dense oracle ──
+f_flash = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
+f_dense = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
+lf, gf = jax.device_get(f_flash(q, k, v))
+note("flash fwd+bwd executed on chip")
+ld, gd = jax.device_get(f_dense(q, k, v))
+note("dense oracle executed on chip")
+
+rel = abs(lf - ld) / max(abs(ld), 1e-9)
+print(f"loss rel diff: {rel:.3e}  (flash {lf:.6g} vs dense {ld:.6g})")
+ok = rel < 2e-2
+for name, a, b in zip("dq dk dv".split(), gf, gd):
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    scale = np.abs(b).max() or 1.0
+    err = np.abs(a - b).max() / scale
+    print(f"grad {name}: max rel-to-peak err {err:.3e}")
+    ok &= err < 5e-2   # bf16 storage dtype; kernels accumulate f32
+print("CORRECTNESS:", "PASS" if ok else "FAIL")
+
+# ── honest timing A/B (value-readback fenced, donation-chained) ──────────
+def timed(fn, reps=20):
+    y = jax.device_get(fn(q, k, v)[0])          # warm + fence
+    t = time.perf_counter()
+    accs = [fn(q, k, v)[0] for _ in range(reps)]
+    jax.device_get(jnp.stack(accs).sum())       # one fence for all reps
+    return (time.perf_counter() - t) / reps * 1e3
+
+
+note("timing flash fwd+bwd")
+ms_flash = timed(f_flash)
+note("timing dense fwd+bwd")
+ms_dense = timed(f_dense)
+print(f"fwd+bwd per call: flash {ms_flash:.2f} ms, dense {ms_dense:.2f} ms, "
+      f"speedup {ms_dense / ms_flash:.2f}x  (B={B} L={L} H={H} D={D})")
+
+# Longer sequence: where flash should win decisively on HBM.
+L2 = 8192
+q2 = jax.random.normal(ks[0], (1, L2, H, D), jnp.bfloat16)
+k2 = jax.random.normal(ks[1], (1, L2, KVH, D), jnp.bfloat16)
+v2 = jax.random.normal(ks[2], (1, L2, KVH, D), jnp.bfloat16)
+
+
+def timed2(loss, reps=10):
+    fn = jax.jit(jax.value_and_grad(loss))
+    y = jax.device_get(fn(q2, k2, v2)[0])   # scalar fence — don't haul grads
+    t = time.perf_counter()
+    accs = [fn(q2, k2, v2)[0] for _ in range(reps)]
+    jax.device_get(jnp.stack(accs).sum())
+    return (time.perf_counter() - t) / reps * 1e3
+
+
+note("timing seq-8192 flash")
+ms_f2 = timed2(lambda q, k, v: jnp.sum(
+    flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2))
+note("timing seq-8192 dense")
+ms_d2 = timed2(lambda q, k, v: jnp.sum(
+    dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2))
+print(f"seq {L2}: flash {ms_f2:.2f} ms, dense {ms_d2:.2f} ms, "
+      f"speedup {ms_d2 / ms_f2:.2f}x")
+print("DONE")
