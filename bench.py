@@ -529,16 +529,11 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # 10-40 s; cached executables survive across worker processes (and
     # across the round's rehearsals vs the driver's real run on the same
     # host), so a cache hit buys the budget fence whole extra arms.
-    try:
-        cache_dir = os.environ.get(
-            "HVD_TPU_BENCH_CACHE",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache"),
-        )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass    # older jax without the knob: compiles stay per-process
+    from horovod_tpu.utils.env import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
 
     if mode == "cpu":
         # The env var alone is NOT enough: a pool plugin's sitecustomize

@@ -125,3 +125,26 @@ class EngineConfig:
                 HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES, 10
             ),
         )
+
+
+def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
+    """Point jax's persistent compilation cache at ``HVD_TPU_BENCH_CACHE``
+    (or ``default_dir``) so compile work survives across processes — the
+    bench orchestrator's workers, rehearsals, the driver's entry-point
+    checks, and the perf-sweep tools all share one cache (entries are
+    keyed by computation + backend, so CPU and TPU entries coexist).
+
+    Must run before the first compilation; safe to call repeatedly.  A jax
+    without the knob (or a read-only path) degrades to per-process
+    compiles silently — callers never depend on the cache for correctness.
+    """
+    path = os.environ.get("HVD_TPU_BENCH_CACHE") or default_dir
+    if not path:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
