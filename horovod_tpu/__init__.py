@@ -113,7 +113,11 @@ from horovod_tpu.optim.fsdp import (  # noqa: F401
     shard_params,
 )
 from horovod_tpu.training import fit, make_eval_step  # noqa: F401
-from horovod_tpu.data import ShardedLoader, shard_indices  # noqa: F401
+from horovod_tpu.data import (  # noqa: F401
+    ShardedLoader,
+    prefetch_to_device,
+    shard_indices,
+)
 from horovod_tpu.timeline import start_timeline, stop_timeline  # noqa: F401
 from horovod_tpu import ops  # noqa: F401
 

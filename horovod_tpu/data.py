@@ -16,6 +16,7 @@ Multi-host: every process builds batches only for its own ranks, and
 
 from __future__ import annotations
 
+import collections
 import math
 import queue
 import threading
@@ -239,3 +240,44 @@ def synthetic_imagenet(
     images = rng.standard_normal((n, image_size, image_size, 3)).astype(np.float32)
     labels = rng.integers(0, num_classes, size=(n,), dtype=np.int64)
     return images, labels
+
+
+def prefetch_to_device(
+    iterator: Iterator[Any], size: int = 2, sharding: Any = None
+) -> Iterator[Any]:
+    """Keep ``size`` batches' device transfers in flight ahead of the
+    consumer.
+
+    ``ShardedLoader`` already overlaps H2D with compute when built with a
+    ``sharding``; this is the standalone equivalent for user-supplied
+    iterators (e.g. a torch ``DataLoader`` driven through the torch
+    frontend, the reference's main data path — its examples get this
+    overlap from ``DataLoader(num_workers=..., pin_memory=True)``).
+    ``jax.device_put`` is asynchronous, so enqueueing batch s+``size``
+    while the step consumes batch s hides the transfer latency; with no
+    ``sharding`` the default device placement is used.
+
+    Yields every input item exactly once, in order; an abandoned iterator
+    drops its in-flight transfers with no thread to unwind (unlike the
+    loader's producer, nothing here blocks).
+    """
+    if size < 1:   # validate at the call site, not at first next()
+        raise ValueError(f"size must be >= 1, got {size}")
+
+    def put(item):
+        return jax.tree.map(
+            (lambda x: jax.device_put(x, sharding)) if sharding is not None
+            else jax.device_put,
+            item,
+        )
+
+    def gen():
+        buf: collections.deque = collections.deque()
+        for item in iterator:
+            buf.append(put(item))
+            if len(buf) > size:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    return gen()

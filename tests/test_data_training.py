@@ -366,3 +366,56 @@ def test_sharded_loader_abandoned_near_end_does_not_wedge():
     leaked = [t.name for t in threading.enumerate()
               if t.name == "horovod_tpu-prefetch" and t.is_alive()]
     assert not leaked, f"prefetch thread wedged at end-of-epoch: {leaked}"
+
+
+class TestPrefetchToDevice:
+    """Standalone device prefetch for user-supplied iterators (the torch
+    DataLoader analogue of the reference's pin_memory+workers overlap)."""
+
+    def test_yields_all_items_in_order(self):
+        import horovod_tpu as hvd
+
+        items = [{"x": np.full((2, 3), i)} for i in range(7)]
+        out = list(hvd.prefetch_to_device(iter(items), size=3))
+        assert len(out) == 7
+        for i, o in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(o["x"]), items[i]["x"])
+            assert isinstance(o["x"], jax.Array)
+
+    def test_respects_sharding(self):
+        import horovod_tpu as hvd
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("d",))
+        sharding = NamedSharding(mesh, P("d"))
+        batches = [np.arange(16.0).reshape(8, 2) for _ in range(3)]
+        out = list(hvd.prefetch_to_device(iter(batches), sharding=sharding))
+        assert len(out) == 3
+        assert out[0].sharding.is_equivalent_to(sharding, ndim=2)
+        np.testing.assert_array_equal(np.asarray(out[0]), batches[0])
+
+    def test_keeps_at_most_size_in_flight(self):
+        import horovod_tpu as hvd
+
+        pulled = []
+
+        def source():
+            for i in range(6):
+                pulled.append(i)
+                yield np.full((1,), i)
+
+        it = hvd.prefetch_to_device(source(), size=2)
+        first = next(it)
+        # Yielding item 0 requires having enqueued 0..2 (size=2 ahead),
+        # but never the whole source.
+        assert np.asarray(first)[0] == 0
+        assert len(pulled) == 3
+        rest = list(it)
+        assert len(rest) == 5 and len(pulled) == 6
+
+    def test_rejects_bad_size(self):
+        import horovod_tpu as hvd
+        import pytest
+
+        with pytest.raises(ValueError, match="size"):
+            list(hvd.prefetch_to_device(iter([]), size=0))
