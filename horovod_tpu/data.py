@@ -264,12 +264,21 @@ def prefetch_to_device(
     if size < 1:   # validate at the call site, not at first next()
         raise ValueError(f"size must be >= 1, got {size}")
 
+    # Multi-host with a cross-process sharding: each process must feed only
+    # its LOCAL shards (make_array_from_process_local_data) — a bare
+    # device_put of host data onto non-addressable devices raises or runs
+    # per-batch out-of-band host collectives that can misorder against
+    # in-flight engine traffic (same hazard ShardedLoader._batches guards).
+    multi = sharding is not None and jax.process_count() > 1
+
+    def put_leaf(x):
+        if multi:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding) if sharding is not None \
+            else jax.device_put(x)
+
     def put(item):
-        return jax.tree.map(
-            (lambda x: jax.device_put(x, sharding)) if sharding is not None
-            else jax.device_put,
-            item,
-        )
+        return jax.tree.map(put_leaf, item)
 
     def gen():
         buf: collections.deque = collections.deque()

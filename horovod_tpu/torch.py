@@ -180,6 +180,16 @@ def allreduce_(tensor, average=True, name=None, *, op=None,
     return tensor
 
 
+def allreduce_async_(tensor, average=True, name=None, *, op=None,
+                     compression=Compression.none) -> int:
+    """Async in-place (reference allreduce_async_, torch/mpi_ops.py:156-176
+    — the call the reference's gradient hooks make): ``synchronize(handle)``
+    copies the reduced result into ``tensor`` and returns it."""
+    h = allreduce_async(tensor, average, name, op=op, compression=compression)
+    _attach_post(h, inplace_dst=tensor)
+    return h
+
+
 # Post-processing for ragged allgathers / rank-major results rides the
 # HandleManager entry itself (set_handle_post/take_handle_post) — under the
 # manager's lock, released with the handle — so an abandoned handle or a
@@ -299,6 +309,14 @@ def broadcast_(tensor, root_rank, name=None):
     return tensor
 
 
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    """Async in-place broadcast (reference broadcast_async_):
+    ``synchronize(handle)`` writes the root's values into ``tensor``."""
+    h = broadcast_async(tensor, root_rank, name)
+    _attach_post(h, inplace_dst=tensor)
+    return h
+
+
 def sparse_allreduce_async(tensor, name=None, *, average: bool = False,
                            ratio: float = 0.01, k: int | None = None) -> int:
     """The fork's top-k sparse allreduce on torch tensors (reference
@@ -357,6 +375,10 @@ def synchronize(handle: int):
     want = post.get("dtype")
     if want is not None and out.dtype != want:
         out = out.to(want)
+    dst = post.get("inplace_dst")
+    if dst is not None:          # the async in-place variants
+        dst.copy_(out)
+        return dst
     return out
 
 

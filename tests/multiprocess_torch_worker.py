@@ -39,6 +39,18 @@ def main() -> None:
     t2 = torch.full((3,), float(me))
     hvd.allreduce_(t2, average=False, name="t.inplace")
     assert torch.allclose(t2, torch.full((3,), 1.0)), t2
+    # ASYNC in-place (reference allreduce_async_ — what gradient hooks
+    # call): synchronize writes into the original tensor and returns it.
+    t3 = torch.full((2, 2), float(me) + 1)
+    h3 = hvd.allreduce_async_(t3, average=True, name="t.async_inplace")
+    ret = hvd.synchronize(h3)
+    assert ret is t3, "synchronize must return the in-place destination"
+    assert torch.allclose(t3, torch.full((2, 2), 1.5)), t3
+    # async in-place broadcast
+    t4 = torch.full((2,), float(me) * 7 + 1)
+    h4 = hvd.broadcast_async_(t4, root_rank=1, name="t.bcast_inplace")
+    assert hvd.synchronize(h4) is t4
+    assert torch.allclose(t4, torch.full((2,), 8.0)), t4
 
     # --- allgather along dim 0.
     g = hvd.allgather(torch.full((2, 2), float(me)), name="t.gather")

@@ -142,6 +142,21 @@ def main() -> None:
                              drop_last=True)[:3]
     assert np.allclose(my_rows, ds_x[want_idx]), (me, my_rows)
 
+    # --- prefetch_to_device with a CROSS-PROCESS sharding: each process
+    # feeds only its local rows; assembled arrays are global rank-major
+    # (the make_array_from_process_local_data branch, not device_put).
+    from horovod_tpu.data import prefetch_to_device
+
+    local_batches = [np.full((1, 4), float(me * 10 + i), np.float32)
+                     for i in range(3)]
+    fetched = list(prefetch_to_device(
+        iter(local_batches), size=2, sharding=first.sharding))
+    assert len(fetched) == 3
+    for i, arr in enumerate(fetched):
+        assert arr.shape == (n, 4), arr.shape
+        mine = np.asarray(arr.addressable_shards[0].data)
+        assert np.allclose(mine, me * 10 + i), (me, i, mine)
+
     hvd.shutdown()
 
     # --- per-rank NEGOTIATE ticks (reference timeline.cc:98-132): rank 0's
