@@ -1,0 +1,32 @@
+#!/bin/bash
+# Chip-window watcher for the claim-based tunnel (docs/troubleshooting.md
+# "Tunnel claim mechanics"): every ~4 min attempt the on-chip flash check —
+# it doubles as the availability probe, self-bounding via its per-stage
+# faulthandler when the claim hangs — and on the first success run the full
+# honest bench.  Artifacts land in $OUT (default /tmp/chipwatch).
+#
+#   nohup tools/chip_window_watch.sh &      # survives the shell
+#
+# The probe-that-claims is the process-that-works (a throwaway probe would
+# consume the very grant it tests for), and every attempt is bounded from
+# OUTSIDE — no in-process timeout interrupts a hung PJRT_Client_Create.
+set -u
+OUT="${OUT:-/tmp/chipwatch}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+mkdir -p "$OUT"
+n=0
+while true; do
+  n=$((n+1))
+  ts=$(date +%H%M%S)
+  if STAGE_TIMEOUT="${STAGE_TIMEOUT:-150}" timeout 900 \
+        python "$REPO/tools/tpu_flash_check.py" \
+        > "$OUT/flash_${ts}.log" 2>&1; then
+    echo "window at $ts (attempt $n)" > "$OUT/WINDOW"
+    sleep 10   # let the claim release cleanly before the bench worker dials
+    ( cd "$REPO" && timeout 1000 python bench.py \
+        > "$OUT/bench_${ts}.json" 2> "$OUT/bench_${ts}.log" )
+    touch "$OUT/DONE"
+    exit 0
+  fi
+  sleep "${PERIOD:-230}"
+done
