@@ -381,3 +381,66 @@ def test_timeline_schema_end_to_end(tmp_path, monkeypatch):
     pids = {e["args"]["name"]: e["pid"] for e in events
             if e["name"] == "process_name"}
     assert {"tls.grad", "tls.gather", "tls.bcast"} <= set(pids)
+
+
+def test_plan_buckets_randomized_invariants():
+    """Seeded randomized sweep of the planner's contract (the reference's
+    response-merging loop, operations.cc:1916-1943): exact cover in order,
+    per-bucket key purity, byte bound except oversize singletons, greedy
+    maximality (no two adjacent buckets it should have merged), and
+    disabled-fusion degeneration to singletons."""
+    rng = np.random.default_rng(1234)
+    dtypes = [np.float32, np.float16, np.int32]
+    for trial in range(200):
+        n = int(rng.integers(0, 24))
+        tensors = [
+            np.zeros(int(rng.integers(1, 5000)),
+                     dtype=dtypes[int(rng.integers(len(dtypes)))])
+            for _ in range(n)
+        ]
+        threshold = int(rng.integers(0, 8192))
+        buckets = fusion.plan_buckets(tensors, threshold)
+        # Exact cover, original order when flattened.
+        flat = [i for b in buckets for i in b]
+        assert flat == list(range(n)), (trial, flat)
+        assert all(b for b in buckets), "no empty buckets"
+        for b in buckets:
+            keys = {tensors[i].dtype for i in b}
+            assert len(keys) == 1, (trial, b, keys)
+            size = sum(tensors[i].nbytes for i in b)
+            if threshold <= 0:
+                assert len(b) == 1
+            elif len(b) > 1:
+                assert size <= threshold, (trial, size, threshold)
+            # len(b) == 1 may legally exceed the threshold (oversize).
+        if threshold > 0:
+            # Greedy maximality: a cut between same-dtype neighbors exists
+            # only because the next tensor did not fit — an all-singletons
+            # degenerate plan must fail here.
+            for b1, b2 in zip(buckets, buckets[1:]):
+                if tensors[b1[0]].dtype == tensors[b2[0]].dtype:
+                    overflow = (sum(tensors[i].nbytes for i in b1)
+                                + tensors[b2[0]].nbytes)
+                    assert overflow > threshold, (trial, b1, b2, overflow)
+
+
+def test_fused_apply_randomized_roundtrip():
+    """fused_apply(identity) must return every tensor bit-identically for
+    random shape mixes at random thresholds (concat/split inverse pair)."""
+    rng = np.random.default_rng(99)
+    for trial in range(20):
+        n = int(rng.integers(1, 12))
+        tensors = [
+            jnp.asarray(
+                rng.standard_normal(
+                    tuple(int(d) for d in
+                          rng.integers(1, 6, size=int(rng.integers(1, 4))))
+                ).astype(np.float32))
+            for _ in range(n)
+        ]
+        out = fusion.fused_apply(tensors, lambda flat: flat,
+                          threshold_bytes=int(rng.integers(0, 512)))
+        assert len(out) == len(tensors)
+        for a, b in zip(tensors, out):
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
