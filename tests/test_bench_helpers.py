@@ -98,3 +98,30 @@ def test_aot_compile_direct_fallback(bench):
     assert flops is None
     assert jnp.allclose(out, 1.0)
     assert fn is plain_step
+
+
+def test_enable_persistent_compile_cache_env_override(tmp_path, monkeypatch):
+    """HVD_TPU_BENCH_CACHE must override the caller's default so every
+    consumer (bench workers, driver entry points, sweep tools) moves to
+    the same directory together."""
+    from horovod_tpu.utils.env import enable_persistent_compile_cache
+
+    orig = jax.config.jax_compilation_cache_dir
+    try:
+        override = str(tmp_path / "override_cache")
+        monkeypatch.setenv("HVD_TPU_BENCH_CACHE", override)
+        enable_persistent_compile_cache(str(tmp_path / "default_cache"))
+        assert jax.config.jax_compilation_cache_dir == override
+
+        monkeypatch.delenv("HVD_TPU_BENCH_CACHE")
+        default = str(tmp_path / "default_cache")
+        enable_persistent_compile_cache(default)
+        assert jax.config.jax_compilation_cache_dir == default
+
+        # No env, no default: a no-op, not a crash (and config unchanged).
+        enable_persistent_compile_cache(None)
+        assert jax.config.jax_compilation_cache_dir == default
+    finally:
+        # The config is process-global: restore so later suite compiles
+        # don't write into this test's deleted tmp dir.
+        jax.config.update("jax_compilation_cache_dir", orig)
