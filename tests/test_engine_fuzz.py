@@ -1,0 +1,92 @@
+"""Seeded randomized interleaving of the eager engine's async surface.
+
+The directed tests pin each path; this sweep drives the engine the way a
+real define-by-run frontend does — many outstanding handles of mixed
+kinds/dtypes/shapes, synchronized in arbitrary order — and checks every
+result against a numpy oracle.  The reference's engine is exercised the
+same way by its async_fused tests (test_torch.py:175-224); here the
+interleaving and fusion grouping are randomized (seeded: deterministic in
+CI) so negotiation-order bugs that directed tests can't reach get a
+chance to surface.
+
+Shapes draw from a small pool so XLA compiles stay bounded.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+SHAPES = [(4,), (2, 3), (8,), (3, 2, 2)]
+DTYPES = [np.float32, np.int32]
+
+
+def _rank_major(n, shape, dtype, rng):
+    if dtype == np.int32:
+        return rng.integers(-50, 50, size=(n, *shape)).astype(np.int32)
+    return rng.standard_normal((n, *shape)).astype(np.float32)
+
+
+@pytest.mark.parametrize("seed", [7, 21, 63])
+def test_engine_random_interleaving(seed):
+    n = hvd.size()
+    rng = np.random.default_rng(seed)
+    pending = []   # (handle, oracle ndarray, kind)
+
+    for i in range(14):
+        kind = rng.choice(["allreduce", "allgather", "broadcast"])
+        shape = SHAPES[int(rng.integers(len(SHAPES)))]
+        dtype = DTYPES[int(rng.integers(len(DTYPES)))]
+        data = _rank_major(n, shape, dtype, rng)
+        name = f"fz{seed}.{i}"
+        if kind == "allreduce":
+            avg = bool(rng.integers(2)) and dtype == np.float32
+            h = hvd.allreduce_async(jnp.asarray(data), name=name, average=avg)
+            want = data.mean(axis=0) if avg else data.sum(axis=0)
+        elif kind == "allgather":
+            h = hvd.allgather_async(jnp.asarray(data), name=name)
+            want = data.reshape(n * shape[0], *shape[1:])
+        else:
+            root = int(rng.integers(n))
+            h = hvd.broadcast_async(jnp.asarray(data), root, name=name)
+            want = data[root]          # result is the root's tensor
+        pending.append((h, want, kind))
+
+        # Randomly drain a prefix of outstanding handles mid-stream, in a
+        # shuffled order — the engine must tolerate out-of-order waits
+        # while later ops are still being negotiated.
+        if rng.integers(3) == 0:       # pending is never empty here
+            k = int(rng.integers(1, len(pending) + 1))
+            batch, pending = pending[:k], pending[k:]
+            order = rng.permutation(len(batch))
+            for j in order:
+                h, want, kind = batch[j]
+                got = np.asarray(hvd.synchronize(h))
+                np.testing.assert_allclose(
+                    got, want, rtol=1e-5, atol=1e-5,
+                    err_msg=f"seed={seed} kind={kind}")
+
+    order = rng.permutation(len(pending))
+    for j in order:
+        h, want, kind = pending[j]
+        got = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"seed={seed} kind={kind} (tail)")
+
+
+def test_engine_random_interleaving_tiny_threshold(monkeypatch):
+    """Same sweep shape at a 1-byte fusion threshold (every op its own
+    bucket) — the planner's other extreme under interleaving."""
+    try:
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1")
+        hvd.shutdown()
+        hvd.init()                      # snapshots the 1-byte threshold
+        test_engine_random_interleaving(5)
+    finally:
+        # undo() restores any PRE-EXISTING threshold (delenv would discard
+        # it and the restoring init below would bake in the default).
+        monkeypatch.undo()
+        hvd.shutdown()
+        hvd.init()
