@@ -21,12 +21,19 @@ while true; do
   if STAGE_TIMEOUT="${STAGE_TIMEOUT:-150}" timeout 900 \
         python "$REPO/tools/tpu_flash_check.py" \
         > "$OUT/flash_${ts}.log" 2>&1; then
-    echo "window at $ts (attempt $n)" > "$OUT/WINDOW"
+    echo "window at $ts (attempt $n)" >> "$OUT/WINDOW"
     sleep 10   # let the claim release cleanly before the bench worker dials
     ( cd "$REPO" && timeout 1000 python bench.py \
         > "$OUT/bench_${ts}.json" 2> "$OUT/bench_${ts}.log" )
-    touch "$OUT/DONE"
-    exit 0
+    # Only a bench that actually executed on the accelerator ends the
+    # watch: the window can close between the flash check's clean exit and
+    # the bench worker's claim, and a CPU-fallback artifact must not eat
+    # the catch (the flash results are kept either way).
+    if grep '"backend":' "$OUT/bench_${ts}.json" \
+        | grep -qv '"backend": "cpu"'; then
+      touch "$OUT/DONE"
+      exit 0
+    fi
   fi
   sleep "${PERIOD:-230}"
 done
