@@ -561,3 +561,53 @@ def test_set_tuned_piggyback_and_rebucketing():
         assert r[0] == ["a", "b"], r          # fused under the default
         assert r[1] == [["c"], ["d"]], r      # split after SetTuned(1)
         assert r[2] == (1, 0.057), r          # exact piggyback everywhere
+
+
+def test_agreement_at_16_ranks_mixed_order_and_stragglers():
+    """Control-plane scale: 16 ranks, shuffled submit orders, some ranks
+    submitting late relative to their first tick — all must converge on
+    identical fused batch sequences.  (The reference CI never exceeded
+    mpirun -np 2; this exercises the coordinator's gather/match/fuse at a
+    pod-slice-sized worker count on the local transport.)"""
+    import random
+
+    names = [f"s16.{i}" for i in range(12)]
+
+    def body(rank, ctrl):
+        order = names[:]
+        random.Random(rank).shuffle(order)
+        late = order[8:]        # stragglers: submitted only after ticking
+        for n in order[:8]:
+            ctrl.submit(AR, "float32", n, (16,))
+        # The partial-readiness tick can legally emit batches (a name every
+        # rank's first-8 happens to cover); count them or drain() hangs.
+        early = list(ctrl.tick().batches)
+        for n in late:
+            ctrl.submit(AR, "float32", n, (16,))
+        done = sum(len(b.names) for b in early)
+        return early + drain(ctrl, len(names) - done)
+
+    results = run_ranks(16, body, threshold=1 << 10)
+    seq0 = [b.names for b in results[0]]
+    assert sorted(n for b in seq0 for n in b) == sorted(names)
+    for r in range(1, 16):
+        assert [b.names for b in results[r]] == seq0, f"rank {r} diverged"
+
+
+def test_tcp_transport_agreement_8_ranks():
+    """The socket control plane at 8 workers (one per chip of a v5e-8):
+    everyone sees the same batch stream over real TCP."""
+    import socket
+
+    with socket.socket() as s:      # OS-assigned port: no collisions with
+        s.bind(("127.0.0.1", 0))    # other tests' fixed listeners
+        port = s.getsockname()[1]
+
+    def body(rank, ctrl):
+        for i in range(4):
+            ctrl.submit(AR, "float32", f"tcp8.{i}", (8,))
+        return drain(ctrl, 4)
+
+    results = run_ranks(8, body, transport=f"tcp:127.0.0.1:{port}")
+    for r in range(1, 8):
+        assert [b.names for b in results[r]] == [b.names for b in results[0]]
