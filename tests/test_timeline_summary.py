@@ -1,0 +1,91 @@
+"""tools/timeline_summary.py against traces the Timeline actually emits."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def summary_mod():
+    spec = importlib.util.spec_from_file_location(
+        "timeline_summary",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "timeline_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_trace(tmp_path):
+    from horovod_tpu.timeline import Timeline
+
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    tl.start("grad/w1", "NEGOTIATE_ALLREDUCE")
+    tl.instant("grad/w1", "NEGOTIATE_TICK_r0")
+    tl.instant("grad/w1", "NEGOTIATE_TICK_r1")
+    tl.end("grad/w1", "NEGOTIATE_ALLREDUCE")
+    tl.start("grad/w1", "ALLREDUCE")
+    tl.end("grad/w1", "ALLREDUCE", {"dtype": "float32", "shape": [2, 4]})
+    tl.start("grad/w2", "NEGOTIATE_ALLREDUCE")
+    tl.end("grad/w2", "NEGOTIATE_ALLREDUCE")
+    tl.close()
+    return path
+
+
+def test_summarize_real_trace(summary_mod, tmp_path):
+    path = _make_trace(tmp_path)
+    s = summary_mod.summarize(summary_mod.load_events(str(path)))
+    assert set(s["tensors"]) == {"grad/w1", "grad/w2"}
+    w1 = s["tensors"]["grad/w1"]
+    assert "ALLREDUCE" in w1["phases"] and "NEGOTIATE_ALLREDUCE" in w1["phases"]
+    assert w1["args"] == {"dtype": "float32", "shape": [2, 4]}
+    assert s["phase_totals"]["NEGOTIATE_ALLREDUCE"] >= w1["phases"]["NEGOTIATE_ALLREDUCE"] > 0
+    assert s["unbalanced"] == []
+
+
+def test_summarize_counts_rank_ticks(summary_mod, tmp_path):
+    path = _make_trace(tmp_path)
+    s = summary_mod.summarize(summary_mod.load_events(str(path)))
+    assert s["ticks"].get("NEGOTIATE_TICK_r0") == 1
+    assert s["ticks"].get("NEGOTIATE_TICK_r1") == 1
+
+
+def test_cli_main_prints_summary(summary_mod, tmp_path, capsys):
+    path = _make_trace(tmp_path)
+    assert summary_mod.main([str(path), "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "grad/w1" in out and "phase totals" in out
+
+
+def test_cli_main_empty_trace(summary_mod, tmp_path, capsys):
+    p = tmp_path / "empty.json"
+    p.write_text("[]")
+    assert summary_mod.main([str(p)]) == 1
+
+
+def test_load_events_tolerates_in_progress_trace(summary_mod, tmp_path):
+    """Summarizing mid-run: the writer's ','-terminated unclosed array
+    must parse (the tool's advertised use)."""
+    from horovod_tpu.timeline import Timeline
+
+    path = tmp_path / "live.json"
+    tl = Timeline(str(path))
+    tl.start("grad/w1", "ALLREDUCE")
+    tl.end("grad/w1", "ALLREDUCE")
+    with tl._lock:
+        tl._flush_locked()   # events on disk, file NOT closed
+    events = summary_mod.load_events(str(path))
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+
+
+def test_unbalanced_counts_every_open_b(summary_mod):
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "t"}},
+        {"ph": "B", "name": "ALLREDUCE", "pid": 1, "ts": 1.0},
+        {"ph": "B", "name": "ALLREDUCE", "pid": 1, "ts": 2.0},
+    ]
+    s = summary_mod.summarize(events)
+    assert len(s["unbalanced"]) == 2

@@ -1,0 +1,133 @@
+"""Summarize a Horovod-TPU timeline (Chrome-trace JSON) in the terminal.
+
+The timeline models each tensor as a "process" whose pid groups its
+events (reference timeline.cc:51-67); chrome://tracing renders it, but a
+quick look during a run shouldn't need a browser:
+
+    python tools/timeline_summary.py /tmp/timeline.json [--top 20]
+
+Prints per-tensor negotiation and execution durations, per-phase totals,
+and the negotiation tick counts per rank (NEGOTIATE_TICK_r<k> instants —
+reference timeline.cc:98-132 parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # An in-progress trace: the writer emits ",\n"-terminated events
+        # and only close() writes the final "]".  Summarizing mid-run is
+        # the tool's point, so complete the array and retry.
+        data = json.loads(text.rstrip().rstrip(",") + "]")
+    # Chrome trace is either a bare event array or {"traceEvents": [...]}.
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def summarize(events: list[dict]) -> dict:
+    tensor_names: dict[int, str] = {}
+    # (pid, name) -> B timestamp stack; durations per (pid, phase name).
+    open_b: dict[tuple, list] = collections.defaultdict(list)
+    durs: dict[tuple, float] = collections.defaultdict(float)
+    args_by_pid: dict[int, dict] = {}
+    ticks = collections.Counter()
+
+    for e in events:
+        ph = e.get("ph")
+        pid = e.get("pid", 0)
+        name = e.get("name", "")
+        if ph == "M" and name == "process_name":
+            tensor_names[pid] = e.get("args", {}).get("name", str(pid))
+        elif ph == "B":
+            open_b[(pid, name)].append(e["ts"])
+        elif ph == "E":
+            stack = open_b.get((pid, name))
+            if stack:
+                durs[(pid, name)] += e["ts"] - stack.pop()
+            if e.get("args"):
+                args_by_pid.setdefault(pid, e["args"])
+        elif ph == "X":
+            if name.startswith("NEGOTIATE_TICK") or name == "CYCLE_START":
+                # Instants (per-rank readiness, mark_cycles engine ticks):
+                # counted, never tabulated as zero-duration "tensors".
+                ticks[name] += 1
+            else:
+                durs[(pid, name)] += e.get("dur", 0.0)
+        elif ph == "b":
+            open_b[(pid, name, e.get("id"))].append(e["ts"])
+        elif ph == "e":
+            stack = open_b.get((pid, name, e.get("id")))
+            if stack:
+                durs[(pid, name)] += e["ts"] - stack.pop()
+
+    unbalanced = sorted(
+        k[1] for k, v in open_b.items() for _ in v   # one entry per open B
+    )
+    per_tensor: dict[str, dict] = {}
+    phase_totals: collections.Counter = collections.Counter()
+    for (pid, phase), us in durs.items():
+        t = per_tensor.setdefault(
+            tensor_names.get(pid, str(pid)), {"phases": {}, "args": {}})
+        t["phases"][phase] = t["phases"].get(phase, 0.0) + us
+        phase_totals[phase] += us
+    for pid, a in args_by_pid.items():
+        if tensor_names.get(pid) in per_tensor:
+            per_tensor[tensor_names[pid]]["args"] = a
+    return {
+        "tensors": per_tensor,
+        "phase_totals": dict(phase_totals),
+        "ticks": dict(ticks),
+        "unbalanced": unbalanced,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace")
+    ap.add_argument("--top", type=int, default=20,
+                    help="show the N tensors with the largest total time")
+    args = ap.parse_args(argv)
+
+    s = summarize(load_events(args.trace))
+    if not s["tensors"]:
+        print("no tensor events found")
+        return 1
+
+    print(f"{len(s['tensors'])} tensors; phase totals (ms):")
+    for phase, us in sorted(s["phase_totals"].items(),
+                            key=lambda kv: -kv[1]):
+        print(f"  {phase:32s} {us / 1e3:10.2f}")
+    if s["ticks"]:
+        print("negotiation ticks:",
+              " ".join(f"{k}={v}" for k, v in sorted(s["ticks"].items())))
+
+    rows = sorted(
+        s["tensors"].items(),
+        key=lambda kv: -sum(kv[1]["phases"].values()),
+    )[: args.top]
+    print(f"\ntop {len(rows)} tensors by total time (ms):")
+    for name, info in rows:
+        total = sum(info["phases"].values()) / 1e3
+        neg = sum(us for p, us in info["phases"].items()
+                  if p.startswith("NEGOTIATE")) / 1e3
+        extra = ""
+        if info["args"]:
+            extra = f"  {info['args'].get('dtype', '')}{info['args'].get('shape', '')}"
+        print(f"  {name:40s} total {total:9.2f}  negotiate {neg:8.2f}{extra}")
+    if s["unbalanced"]:
+        print(f"\nWARNING: {len(s['unbalanced'])} unbalanced B/E pairs: "
+              f"{sorted(set(s['unbalanced']))[:5]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
