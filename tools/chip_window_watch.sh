@@ -16,6 +16,13 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 mkdir -p "$OUT"
 n=0
 while true; do
+  # Hard deadline: the chip claim is EXCLUSIVE, so a watcher still dialing
+  # when the round's official bench runs would steal its grant.  Stop
+  # early (epoch seconds; default: never).
+  if [ -n "${DEADLINE_EPOCH:-}" ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+    echo "deadline reached; stopping" >> "$OUT/daemon.log"
+    exit 0
+  fi
   n=$((n+1))
   ts=$(date +%H%M%S)
   if STAGE_TIMEOUT="${STAGE_TIMEOUT:-150}" timeout 900 \
