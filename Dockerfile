@@ -1,0 +1,34 @@
+# Horovod-TPU container — the TPU-VM analogue of the reference's CUDA
+# Dockerfile (which pins CUDA/cuDNN/NCCL; none of that matrix exists on
+# TPU — the XLA runtime ships with jax[tpu]).
+#
+# Build:   docker build -t horovod-tpu .
+# Run on a TPU VM (the container needs the accel devices and host net):
+#   docker run --privileged --net=host -it horovod-tpu
+#   root@tpu-vm:/examples# python keras_mnist_advanced.py
+# Multi-host pod slice: one container per host, launcher run per host
+# with that host's --node-rank (see docs/docker.md).
+#
+# CPU-only development build (no TPU wheel):
+#   docker build --build-arg JAX_EXTRA=cpu -t horovod-tpu:cpu .
+
+FROM python:3.11-slim-bookworm
+
+# g++ builds the native controller (libhvdtpu.so) on first use;
+# setup.py also pre-builds it at install time when a toolchain exists.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential \
+        git \
+    && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_EXTRA=tpu
+RUN pip install --no-cache-dir -U pip && \
+    pip install --no-cache-dir -U "jax[${JAX_EXTRA}]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+COPY . /horovod_tpu
+RUN pip install --no-cache-dir "/horovod_tpu[test]" && \
+    cp -r /horovod_tpu/examples /examples
+
+WORKDIR /examples
+CMD ["/bin/bash"]
