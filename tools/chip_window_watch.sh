@@ -39,6 +39,12 @@ while true; do
     if grep '"backend":' "$OUT/bench_${ts}.json" \
         | grep -qv '"backend": "cpu"'; then
       touch "$OUT/DONE"
+      # Window still open?  Spend it on tuning data: the sweep self-bounds
+      # per stage, prints a parseable RESULT line per config, and shares
+      # the persistent compile cache with the bench it just warmed.
+      sleep 10
+      STAGE_TIMEOUT=240 timeout 1800 python "$REPO/tools/tpu_perf_sweep.py" \
+          > "$OUT/sweep_${ts}.log" 2>&1
       exit 0
     fi
   fi
