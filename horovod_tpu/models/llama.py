@@ -52,6 +52,17 @@ class LlamaConfig:
     attn_impl: str = "dense"  # dense | blockwise | ring | ulysses | ulysses_flash | flash
     attn_block_size: int = 512
     remat: bool = True                 # jax.checkpoint each scanned layer
+    # Named jax.checkpoint policy for the layer remat — the middle ground
+    # between remat=False (keep everything) and full remat (recompute
+    # everything).  "dots_saveable" keeps every matmul output (incl.
+    # attention scores) and recomputes only the cheap elementwise chains —
+    # usually the best FLOPs/HBM trade on TPU.
+    # "dots_with_no_batch_dims_saveable" keeps just the weight-projection
+    # matmuls and also recomputes the head-batched attention einsums — a
+    # notch more recompute/less memory than dots_saveable (NOT near-full
+    # remat: the eight projections per layer are all saved).
+    # None = full remat (save nothing).
+    remat_policy: str | None = None
     # Chunked fused linear+cross-entropy (ops/fused_xent.py): loss without
     # the [B·L, V] logits tensor; None keeps the plain path.
     fused_loss_chunk: int | None = None
@@ -198,6 +209,29 @@ def _attention(cfg: LlamaConfig, q, k, v, *, positions_offset, sp_axis):
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
+# Zero-config policies only: jax.checkpoint_policies also exposes policy
+# FACTORIES (save_only_these_names, save_from_both_policies, ...) that
+# take arguments — passing one of those bare to jax.checkpoint misbehaves
+# at trace time instead of failing fast, hence the explicit allowlist.
+_REMAT_POLICIES = (
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+    "everything_saveable",
+    "nothing_saveable",
+)
+
+
+def _resolve_remat_policy(cfg: "LlamaConfig"):
+    if cfg.remat_policy is None:
+        return None
+    if cfg.remat_policy not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; pick one of "
+            f"{_REMAT_POLICIES}"
+        )
+    return getattr(jax.checkpoint_policies, cfg.remat_policy)
+
+
 def forward(
     params: dict,
     tokens: jax.Array,
@@ -215,6 +249,12 @@ def forward(
     ``return_hidden=True`` stops after the final norm ([B, L, D]) so the
     fused loss can stream the vocab projection itself.
     """
+    if cfg.remat_policy is not None and not cfg.remat:
+        raise ValueError(
+            "remat_policy is set but remat=False — policy-based remat "
+            "needs remat=True (remat_policy alone does nothing)"
+        )
+    _resolve_remat_policy(cfg)      # fail fast on a bad name either way
     b, l = tokens.shape
     dt = cfg.dtype
     # gather first, THEN cast: converts [B, L, D] activations, not a full
@@ -240,7 +280,7 @@ def forward(
         return x, None
 
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        layer = jax.checkpoint(layer, policy=_resolve_remat_policy(cfg))
 
     x, _ = lax.scan(layer, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)

@@ -337,3 +337,53 @@ def test_generate_with_sampling_runs():
     t = np.asarray(toks)
     assert t.shape == (2, 3)
     assert ((t >= 0) & (t < cfg.vocab_size)).all(), t
+
+
+@pytest.mark.parametrize("policy", [None, "dots_saveable",
+                                    "dots_with_no_batch_dims_saveable"])
+def test_llama_remat_policy_value_and_grads_unchanged(policy):
+    """Remat policies trade memory for recompute; value AND gradients must
+    be bit-comparable to the no-remat forward."""
+    base = llama.llama_tiny(dtype=jnp.float32, remat=False)
+    rp = llama.llama_tiny(dtype=jnp.float32, remat=True, remat_policy=policy)
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                base.vocab_size)
+    batch = (tokens, tokens)
+
+    l0, g0 = jax.value_and_grad(llama.make_loss_fn(base))(params, batch)
+    l1, g1 = jax.value_and_grad(llama.make_loss_fn(rp))(params, batch)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_llama_unknown_remat_policy_raises():
+    cfg = llama.llama_tiny(remat=True, remat_policy="not_a_policy")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama.forward(params, tokens, cfg)
+
+
+def test_llama_remat_policy_without_remat_raises():
+    cfg = llama.llama_tiny(remat=False, remat_policy="dots_saveable")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="remat=False"):
+        llama.forward(params, tokens, cfg)
+
+
+def test_llama_policy_factory_names_rejected():
+    """jax.checkpoint_policies factories (argument-taking) are real
+    attributes but NOT policies; the allowlist must reject them."""
+    cfg = llama.llama_tiny(remat=True,
+                           remat_policy="save_only_these_names")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama.forward(params, tokens, cfg)
