@@ -1,0 +1,80 @@
+"""Harvest chip-window artifacts from the watcher's output directory into
+docs/artifacts/ with provenance stamps.
+
+    python tools/harvest_window.py [--out docs/artifacts] [--src /tmp/chipwatch]
+
+Copies: any bench_*.json whose backend is non-cpu (honest post-fix bench),
+flash_*.log containing correctness verdicts, sweep_*.log RESULT lines, and
+prints a summary of what landed (or why nothing did: the attempt trail).
+Idempotent — existing files are not overwritten.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", default="/tmp/chipwatch")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "artifacts"))
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    landed = []
+
+    for p in sorted(glob.glob(os.path.join(args.src, "bench_*.json"))):
+        try:
+            line = json.loads(open(p).read().strip().splitlines()[-1])
+        except Exception:
+            continue
+        backend = line.get("extras", {}).get("backend")
+        if backend and backend != "cpu":
+            dst = os.path.join(
+                args.out, "r3_live_bench_honest_" + os.path.basename(p))
+            if not os.path.exists(dst):
+                shutil.copy(p, dst)
+            landed.append((dst, f"backend={backend} value={line.get('value')} "
+                                f"mfu={line.get('mfu')}"))
+
+    for p in sorted(glob.glob(os.path.join(args.src, "flash_*.log"))):
+        text = open(p, errors="replace").read()
+        if "CORRECTNESS:" in text:
+            dst = os.path.join(
+                args.out, "r3_flash_oncheck_" + os.path.basename(p))
+            if not os.path.exists(dst):
+                shutil.copy(p, dst)
+            verdict = re.search(r"CORRECTNESS: \w+", text)
+            landed.append((dst, verdict.group(0) if verdict else "?"))
+
+    for p in sorted(glob.glob(os.path.join(args.src, "sweep_*.log"))):
+        results = [ln for ln in open(p, errors="replace")
+                   if ln.startswith("RESULT ")]
+        if results:
+            dst = os.path.join(
+                args.out, "r3_perf_sweep_" + os.path.basename(p))
+            if not os.path.exists(dst):
+                shutil.copy(p, dst)
+            landed.append((dst, f"{len(results)} configs"))
+
+    if landed:
+        print("harvested:")
+        for dst, note in landed:
+            print(f"  {dst}: {note}")
+        print("\nNEXT: add provenance to docs/artifacts/README.md, cite the "
+              "honest numbers in docs/benchmarks.md + CHANGELOG.md, commit.")
+        return 0
+    attempts = len(glob.glob(os.path.join(args.src, "flash_*.log")))
+    print(f"nothing to harvest: no non-cpu bench/flash/sweep results among "
+          f"{attempts} watcher attempts (chip never grantable)")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
