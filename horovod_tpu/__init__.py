@@ -70,6 +70,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     alltoall_async,
     broadcast,
     broadcast_async,
+    engine_stats,
     grouped_allreduce_eager,
     poll,
     sparse_allreduce,

@@ -33,6 +33,7 @@ Eager tensors use the **rank-major** representation (see
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import sys
@@ -190,6 +191,11 @@ class EagerEngine:
                     window_flushes=cfg.autotune_steady_state_samples,
                     log_path=cfg.autotune_log,
                 )
+        # Observability counters (hvd.engine_stats()): updated under the
+        # engine's own locks on their paths (enqueue under _lock, dispatch
+        # under _flush_lock); reads are snapshots, not a barrier.  Must
+        # exist before the cycle thread starts flushing.
+        self.stats: dict[str, int] = collections.Counter()
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )
@@ -200,6 +206,13 @@ class EagerEngine:
                 target=self._stall_loop, name="horovod_tpu-stall-check", daemon=True
             )
             self._stall_thread.start()
+
+    def _mark_error(self, handle: int, err: Exception) -> None:
+        """Every handle failure goes through here so ``stats["errors"]``
+        counts controller-path rejections (duplicate names, negotiation
+        errors, shutdown orphans) the same as dispatch failures."""
+        self.handles.mark_error(handle, err)
+        self.stats["errors"] += 1
 
     def _maybe_native_controller(self, cfg):
         """Bring up the native coordination engine (native/src/controller.cc)
@@ -294,6 +307,7 @@ class EagerEngine:
             if self._shutdown.is_set():
                 raise RuntimeError("horovod_tpu engine has been shut down")
             self._queue.extend(pendings)
+            self.stats["ops_enqueued"] += len(pendings)
 
     def _fuse_key(self, p: _PendingOp):
         """Fusability key for :func:`fusion.plan_buckets` — the eager
@@ -368,11 +382,9 @@ class EagerEngine:
                 for bucket in buckets:
                     group = [batch[i] for i in bucket]
                     if group[0].kind == "allreduce":
-                        out = self._dispatch_allreduce_group(group)
+                        out, nb = self._dispatch_allreduce_group(group)
                         if out is not None:
-                            ar_bytes += sum(
-                                _per_rank_nbytes(p.tensor) for p in group
-                            )
+                            ar_bytes += nb
                             sample_out = out
                     else:
                         assert len(group) == 1
@@ -440,7 +452,7 @@ class EagerEngine:
                 # The reference rejects duplicate in-flight names at enqueue
                 # (operations.cc:2124-2134).
                 self._end_negotiate(p)
-                self.handles.mark_error(
+                self._mark_error(
                     p.handle,
                     RuntimeError(f"Duplicate tensor name in flight: {p.name}"),
                 )
@@ -458,7 +470,7 @@ class EagerEngine:
                 # Per-op containment, like the non-controller dispatch path:
                 # a rejected request fails ITS handle, not the whole flush.
                 self._end_negotiate(p)
-                self.handles.mark_error(p.handle, e)
+                self._mark_error(p.handle, e)
                 continue
             self._submitted[p.name] = p
         try:
@@ -468,7 +480,7 @@ class EagerEngine:
             # their handles so waiters unblock instead of hanging.
             for p in self._submitted.values():
                 self._end_negotiate(p)
-                self.handles.mark_error(p.handle, e)
+                self._mark_error(p.handle, e)
             self._submitted.clear()
             raise
         if self.timeline:
@@ -497,11 +509,11 @@ class EagerEngine:
             if b.error:
                 err = RuntimeError(b.error)
                 for p in ops:
-                    self.handles.mark_error(p.handle, err)
+                    self._mark_error(p.handle, err)
             elif ops[0].kind == "allreduce":
-                out = self._dispatch_allreduce_group(ops)
+                out, nb = self._dispatch_allreduce_group(ops)
                 if out is not None:
-                    ar_bytes += sum(_per_rank_nbytes(p.tensor) for p in ops)
+                    ar_bytes += nb
                     sample_out = out
             else:
                 for p in ops:
@@ -516,7 +528,7 @@ class EagerEngine:
             )
             for p in self._submitted.values():
                 self._end_negotiate(p)
-                self.handles.mark_error(p.handle, err)
+                self._mark_error(p.handle, err)
             self._submitted.clear()
             self._shutdown.set()
         if self.autotuner is not None and ar_bytes:
@@ -650,9 +662,12 @@ class EagerEngine:
         return fn
 
     def _dispatch_allreduce_group(self, group: list[_PendingOp]):
-        """Dispatch one fused bucket; returns the last output array (for
-        the autotuner's completion probe) or None on error."""
+        """Dispatch one fused bucket; returns ``(last_output_or_None,
+        bucket_bytes)`` — the output feeds the autotuner's completion
+        probe, the per-rank payload bytes feed stats and the autotune
+        sample (computed once here so the two meters cannot diverge)."""
         names = [p.name for p in group]
+        nbytes = sum(_per_rank_nbytes(p.tensor) for p in group)
         # Snapshot: start_timeline() may attach a timeline while we're in
         # the try block, and emitting E events whose B never happened would
         # break the trace's B/E balance.
@@ -670,11 +685,15 @@ class EagerEngine:
             for p, out in zip(group, outs):
                 shape = p.tensor.shape if ps is not None else p.tensor.shape[1:]
                 self.handles.mark_dispatched(p.handle, out.reshape(shape))
-            return outs[-1]
+            self.stats["batches_dispatched"] += 1
+            if len(group) > 1:
+                self.stats["tensors_fused"] += len(group)
+            self.stats["allreduce_bytes"] += nbytes
+            return outs[-1], nbytes
         except Exception as e:
             for p in group:
-                self.handles.mark_error(p.handle, e)
-            return None
+                self._mark_error(p.handle, e)
+            return None, nbytes
         finally:
             if tl:
                 for n, p in zip(names, group):
@@ -780,8 +799,9 @@ class EagerEngine:
                 self._mark_single(p, fn(p.tensor))
             else:  # pragma: no cover
                 raise ValueError(f"unknown op kind {p.kind}")
+            self.stats["batches_dispatched"] += 1
         except Exception as e:
-            self.handles.mark_error(p.handle, e)
+            self._mark_error(p.handle, e)
         finally:
             if tl:
                 tl.end(p.name, p.kind.upper(), _op_end_args(p))
@@ -1053,6 +1073,21 @@ def poll(handle: int) -> bool:
     eng = _engine()
     eng.flush()
     return eng.handles.poll(handle)
+
+
+def engine_stats() -> dict:
+    """Snapshot of the engine's observability counters.
+
+    Keys: ``ops_enqueued``, ``batches_dispatched`` (one compiled collective
+    launch each), ``tensors_fused`` (ops that rode a multi-tensor fused
+    bucket — the Tensor Fusion win meter), ``allreduce_bytes`` (per-rank
+    payload), ``errors`` (failed handles, dispatch or negotiation).
+    Values are monotonic since ``init()``; before the engine's first eager
+    op this reports ``{}``.  A snapshot, not a barrier: in-flight ops may
+    not be counted yet.
+    """
+    eng = basics._state.engine
+    return dict(eng.stats) if eng is not None else {}
 
 
 def take_handle_post(handle: int):

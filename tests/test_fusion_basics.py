@@ -444,3 +444,41 @@ def test_fused_apply_randomized_roundtrip():
         for a, b in zip(tensors, out):
             assert a.shape == b.shape
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_stats_counters():
+    """hvd.engine_stats(): fused grouped ops count into tensors_fused and
+    one batch; errors and bytes accumulate; pre-engine state is {}."""
+    hvd.shutdown()
+    assert hvd.engine_stats() == {}
+    hvd.init()
+
+    outs = hvd.grouped_allreduce_eager(
+        [hvd.per_rank(lambda r: jnp.ones(4) * r) for _ in range(3)],
+        average=True,
+    )
+    jax.block_until_ready(outs)
+    s = hvd.engine_stats()
+    assert s["ops_enqueued"] >= 3
+    assert s["batches_dispatched"] >= 1
+    assert s["tensors_fused"] >= 3          # the group rode ONE bucket
+    assert s["allreduce_bytes"] >= 3 * 4 * 4
+    assert s.get("errors", 0) == 0
+
+    # A failing dispatch lands on the error counter (and the handle).
+    before = hvd.engine_stats().get("errors", 0)
+
+    import horovod_tpu.ops.eager as eager_mod
+
+    eng = eager_mod._engine()
+    p = eager_mod._PendingOp(
+        handle=eng.handles.allocate(), kind="allreduce",
+        tensor=hvd.per_rank(lambda r: jnp.ones(2)), name="stats.err",
+        op=hvd.Average, compression=None,
+    )
+    # Sabotage: a compression object without compress() raises in dispatch.
+    p.compression = object()
+    eng.enqueue(p)
+    with pytest.raises(Exception):
+        hvd.synchronize(p.handle)   # error reaches the waiter AND releases
+    assert hvd.engine_stats().get("errors", 0) > before
