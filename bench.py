@@ -486,29 +486,40 @@ def _bench_fusion(hvd, on_tpu: bool) -> dict:
         acc + jnp.stack([jnp.sum(o.astype(jnp.float32)) for o in outs]).sum()
     )
 
-    def run_config(threshold: str) -> float:
+    def run_config(threshold: str) -> tuple[float, int]:
+        """Returns (seconds/round, engine tensors_fused counter) — the
+        counter proves the knob actually changed BUCKETING, so the A/B is
+        a fusion comparison and not two identical runs timed twice."""
         hvd.shutdown()
         os.environ["HOROVOD_FUSION_THRESHOLD"] = threshold
         os.environ["HOROVOD_CYCLE_TIME"] = "1"
         hvd.init()
         outs = hvd.grouped_allreduce_eager(grads, average=True)  # warmup
         _readback(digest(jnp.float32(0), outs))     # + digest compile
+        # Delta from AFTER warmup: the counter is monotonic since init(),
+        # and warmup fusions must not vouch for the timed rounds.
+        fused0 = int(hvd.engine_stats().get("tensors_fused", 0))
         acc = jnp.float32(0)
         t0 = time.perf_counter()
         for _ in range(rounds):
             outs = hvd.grouped_allreduce_eager(grads, average=True)
             acc = digest(acc, outs)
         _readback(acc)
-        return (time.perf_counter() - t0) / rounds
+        dt = (time.perf_counter() - t0) / rounds
+        return dt, int(hvd.engine_stats().get("tensors_fused", 0)) - fused0
 
     try:
-        fused_s = run_config(str(64 * 1024 * 1024))
-        unfused_s = run_config("0")
+        fused_s, fused_count = run_config(str(64 * 1024 * 1024))
+        unfused_s, unfused_count = run_config("0")
         return {
             "fusion_speedup": round(unfused_s / fused_s, 3),
             "fused_ms": round(fused_s * 1e3, 2),
             "unfused_ms": round(unfused_s * 1e3, 2),
             "fusion_tensors": len(grads),
+            # Engine counters per arm: fused arm must show ops riding
+            # multi-tensor buckets; the threshold-0 arm must show none.
+            "fused_arm_tensors_fused": fused_count,
+            "unfused_arm_tensors_fused": unfused_count,
         }
     finally:
         os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
