@@ -581,6 +581,7 @@ class EagerEngine:
                 if report:
                     stalled.append(report)
             if stalled:
+                self.stats["stall_warnings"] += 1
                 print(
                     "WARNING: One or more tensors were submitted to be "
                     "reduced, gathered or broadcasted by subset of ranks and "
@@ -1081,7 +1082,8 @@ def engine_stats() -> dict:
     Keys: ``ops_enqueued``, ``batches_dispatched`` (one compiled collective
     launch each), ``tensors_fused`` (ops that rode a multi-tensor fused
     bucket — the Tensor Fusion win meter), ``allreduce_bytes`` (per-rank
-    payload), ``errors`` (failed handles, dispatch or negotiation).
+    payload), ``errors`` (failed handles, dispatch or negotiation),
+    ``stall_warnings`` (stall-checker firings).
     Values are monotonic since ``init()``; before the engine's first eager
     op this reports ``{}``.  A snapshot, not a barrier: in-flight ops may
     not be counted yet.

@@ -482,3 +482,41 @@ def test_engine_stats_counters():
     with pytest.raises(Exception):
         hvd.synchronize(p.handle)   # error reaches the waiter AND releases
     assert hvd.engine_stats().get("errors", 0) > before
+
+
+def test_engine_stats_counts_stall_warnings(monkeypatch, capsys):
+    """A sub-second stall window + an op enqueued without synchronize must
+    fire the stall warning AND its counter."""
+    import time as _time
+
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME", "0.2")
+    hvd.shutdown()
+    hvd.init()
+    try:
+        import horovod_tpu.ops.eager as eager_mod
+
+        eng = eager_mod._engine()
+        # Park an op in the queue without flushing: pause the cycle thread
+        # by enqueueing directly with a stale timestamp.
+        p = eager_mod._PendingOp(
+            handle=eng.handles.allocate(), kind="allreduce",
+            tensor=hvd.per_rank(lambda r: jnp.ones(2)), name="stall.x",
+        )
+        # Hold the flush lock so the cycle thread cannot drain the queue —
+        # the single-controller analogue of "a subset of ranks is missing"
+        # (otherwise dispatch happens within one cycle and nothing stalls).
+        with eng._flush_lock:
+            with eng._lock:
+                p.enqueued_at = _time.monotonic() - 10.0
+                eng._queue.append(p)
+                eng.stats["ops_enqueued"] += 1
+            deadline = _time.monotonic() + 5.0
+            while (_time.monotonic() < deadline
+                   and hvd.engine_stats().get("stall_warnings", 0) == 0):
+                _time.sleep(0.05)
+        assert hvd.engine_stats().get("stall_warnings", 0) >= 1
+        assert "Stalled ops" in capsys.readouterr().err
+    finally:
+        monkeypatch.undo()
+        hvd.shutdown()
+        hvd.init()
