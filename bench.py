@@ -302,6 +302,58 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
     }
 
 
+def _bench_llama_decode(hvd, on_tpu: bool) -> dict:
+    """End-to-end GENERATION throughput (extras arm, TPU only, runs last):
+    one prefill + a jitted lax.scan of cached greedy decode steps — the
+    inference stack (models/llama.py generate; the reference has no
+    inference benchmark, this is beyond-parity evidence).  Keys say
+    generate_, not decode_: each timed rep includes the prompt prefill, so
+    this is tokens-out per wall-clock of the whole call, comparable
+    round-over-round only at the recorded prompt/new-token shape."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import llama
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense")
+        bsz, prompt_len, new = 2, 8, 8
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",              # decode = 1-token steps
+        )
+        bsz, prompt_len, new = 8, 128, 256
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(
+        jax.random.key(3), (bsz, prompt_len), 0, cfg.vocab_size, jnp.int32)
+
+    gen = jax.jit(lambda p, t: llama.generate(
+        p, t, cfg, max_new_tokens=new, max_len=prompt_len + new))
+    out = gen(params, prompt)
+    _readback(out[:, -1])                 # compile + warmup, real fence
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        # Chain reps through a value-preserving data dependency (add the
+        # previous output's first column times zero) so the single final
+        # readback honestly fences every rep — independent calls could
+        # still be executing behind the relay (see _readback).
+        chained = prompt + (out[:, :1] * 0).astype(prompt.dtype)
+        out = gen(params, chained)
+    _readback(out[:, -1])
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "generate_tokens_per_sec_per_chip": round(bsz * new / dt, 1),
+        "generate_ms_per_new_token": round(dt / new * 1e3, 3),
+        "generate_shape": f"b{bsz}_prompt{prompt_len}_new{new}",
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -621,7 +673,8 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # New arms go LAST: under the budget fence, the arms earlier rounds
     # already recorded (llama/fusion) keep priority for comparability.
     for fn in (_bench_llama, _bench_fusion, _bench_llama_fused,
-               _bench_resnet50, _bench_resnet101_big_batch):
+               _bench_resnet50, _bench_resnet101_big_batch,
+               _bench_llama_decode):
         if time.monotonic() - _T_START > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue
