@@ -21,7 +21,13 @@ Beyond the reference's img/sec, the primary line carries TPU-first metrics:
 * ``extras.fusion_speedup`` — VGG-16-shaped eager gradient set pushed
   through the engine with ``HOROVOD_FUSION_THRESHOLD`` at its 64 MiB default
   vs 0, proving the Tensor Fusion knob is observable
-  (/root/reference/docs/tensor-fusion.md).
+  (/root/reference/docs/tensor-fusion.md); per-arm ``*_tensors_fused``
+  engine counters prove the knob changed bucketing.
+* ``extras.llama_fused_loss_*`` — the chunked fused linear+cross-entropy
+  A/B; ``extras.resnet101_bs128_*`` — MFU-ceiling probe beyond the
+  reference's bs-64 config; ``extras.generate_*`` — end-to-end KV-cache
+  generation throughput; ``extras.tunnel_rtt_ms`` — the relay's measured
+  round-trip floor (see "Reading MFU" in docs/benchmarks.md).
 
 TPU bring-up — orchestrator/worker split
 ----------------------------------------
