@@ -125,3 +125,15 @@ def test_enable_persistent_compile_cache_env_override(tmp_path, monkeypatch):
         # The config is process-global: restore so later suite compiles
         # don't write into this test's deleted tmp dir.
         jax.config.update("jax_compilation_cache_dir", orig)
+
+
+def test_generate_arm_rehearsal_path(bench, monkeypatch):
+    """The generation extras arm's rehearsal config runs end-to-end on the
+    CPU stand-in and reports the labeled shape."""
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HVD_TPU_BENCH_FORCE_TPU_PATHS", "1")
+    out = bench._bench_llama_decode(hvd, True)
+    assert out["generate_tokens_per_sec_per_chip"] > 0
+    assert out["generate_ms_per_new_token"] > 0
+    assert out["generate_shape"] == "b2_prompt8_new8"
