@@ -142,9 +142,34 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
     if not path:
         return
     try:
+        import hashlib
+        import platform
+
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", path)
+        # Sub-directory keyed by a host fingerprint: XLA:CPU AOT blobs
+        # bake in the compile machine's features, and loading them on a
+        # different host can SIGILL (the loader warns exactly this).  The
+        # persistent dir can outlive the machine (it sits in the repo), so
+        # never let one host's blobs reach another's loader.
+        try:
+            from pathlib import Path
+
+            cpu = Path("/proc/cpuinfo").read_text()
+            # x86 lists "flags", aarch64 lists "Features"; hash whichever
+            # is present (an empty fallback would give every host of an
+            # architecture the same key and defeat the guard).
+            flags = next(
+                (ln for ln in cpu.splitlines()
+                 if ln.startswith(("flags", "Features"))),
+                platform.processor() or cpu[:512],
+            )
+        except OSError:
+            flags = platform.processor() or platform.platform()
+        host_key = hashlib.sha1(
+            (platform.machine() + ":" + flags).encode()).hexdigest()[:10]
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(path, host_key))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass

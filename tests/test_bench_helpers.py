@@ -111,16 +111,23 @@ def test_enable_persistent_compile_cache_env_override(tmp_path, monkeypatch):
         override = str(tmp_path / "override_cache")
         monkeypatch.setenv("HVD_TPU_BENCH_CACHE", override)
         enable_persistent_compile_cache(str(tmp_path / "default_cache"))
-        assert jax.config.jax_compilation_cache_dir == override
+        # The helper appends a host-fingerprint subdir (AOT blobs bake in
+        # machine features; a foreign host's blobs could SIGILL).
+        assert jax.config.jax_compilation_cache_dir.startswith(override)
+        got_override = jax.config.jax_compilation_cache_dir
 
         monkeypatch.delenv("HVD_TPU_BENCH_CACHE")
         default = str(tmp_path / "default_cache")
         enable_persistent_compile_cache(default)
-        assert jax.config.jax_compilation_cache_dir == default
+        assert jax.config.jax_compilation_cache_dir.startswith(default)
+        got_default = jax.config.jax_compilation_cache_dir
+        # Same host fingerprint under both roots.
+        assert (os.path.basename(got_override)
+                == os.path.basename(got_default))
 
         # No env, no default: a no-op, not a crash (and config unchanged).
         enable_persistent_compile_cache(None)
-        assert jax.config.jax_compilation_cache_dir == default
+        assert jax.config.jax_compilation_cache_dir == got_default
     finally:
         # The config is process-global: restore so later suite compiles
         # don't write into this test's deleted tmp dir.
