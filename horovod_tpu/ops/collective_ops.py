@@ -71,10 +71,41 @@ def _reduce(x: jax.Array, op: _ReduceOp, axis_name) -> jax.Array:
     if op is Max:
         return lax.pmax(x, axis_name)
     if op is Product:
-        # No pprod primitive; all_gather + product keeps it exact for ints.
-        gathered = lax.all_gather(x, axis_name)
-        return jnp.prod(gathered, axis=0)
+        return _pprod(x, axis_name)
     raise ValueError(f"unknown reduce op {op!r}")
+
+
+def _pprod(x: jax.Array, axis_name) -> jax.Array:
+    """Product reduction without a pprod primitive, in O(1) extra memory.
+
+    An ``all_gather`` + ``prod`` would materialize world_size copies of the
+    tensor per device (1 GiB × 64 ranks = 64 GiB); instead exchange-and-
+    multiply keeps exactly one extra buffer in flight: a recursive-doubling
+    butterfly (log₂ n ``ppermute`` rounds, partner at distance 2ⁱ) for
+    power-of-two axes, a ring (n-1 shift-by-one rounds) otherwise.  Exact
+    for ints; floats reassociate like any tree reduction.  Tuple axes
+    reduce one axis at a time — multiplication commutes, so the product
+    over (a, b) is the product over a of the product over b.
+    """
+    if isinstance(axis_name, (tuple, list)):
+        for a in axis_name:
+            x = _pprod(x, a)
+        return x
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1) == 0:
+        for i in range(n.bit_length() - 1):
+            d = 1 << i
+            perm = [(r, r ^ d) for r in range(n)]
+            x = x * lax.ppermute(x, axis_name, perm)
+        return x
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    out, cur = x, x
+    for _ in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        out = out * cur
+    return out
 
 
 class ProcessSet:

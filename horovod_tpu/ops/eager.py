@@ -194,8 +194,15 @@ class EagerEngine:
         # Observability counters (hvd.engine_stats()): updated under the
         # engine's own locks on their paths (enqueue under _lock, dispatch
         # under _flush_lock); reads are snapshots, not a barrier.  Must
-        # exist before the cycle thread starts flushing.
-        self.stats: dict[str, int] = collections.Counter()
+        # exist before the cycle thread starts flushing.  Every key is
+        # pre-seeded so the key set never grows after __init__ — an
+        # unlocked dict() snapshot in engine_stats() would otherwise race
+        # a cycle-thread first-insertion and can raise "dictionary changed
+        # size during iteration".
+        self.stats: dict[str, int] = collections.Counter({
+            "ops_enqueued": 0, "batches_dispatched": 0, "tensors_fused": 0,
+            "allreduce_bytes": 0, "errors": 0, "stall_warnings": 0,
+        })
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )

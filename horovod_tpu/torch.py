@@ -184,7 +184,15 @@ def allreduce_async_(tensor, average=True, name=None, *, op=None,
                      compression=Compression.none) -> int:
     """Async in-place (reference allreduce_async_, torch/mpi_ops.py:156-176
     — the call the reference's gradient hooks make): ``synchronize(handle)``
-    copies the reduced result into ``tensor`` and returns it."""
+    copies the reduced result into ``tensor`` and returns it.
+
+    Divergence from the reference: there the tensor IS the op's output
+    buffer, so after the op completes the data is visible without
+    ``synchronize``.  Here the reduced value lands in ``tensor`` only when
+    ``synchronize(handle)`` runs — ``poll(handle) == True`` means the
+    result is ready to copy, not that it has been copied.  Code that polls
+    and then reads ``tensor`` without synchronizing sees the pre-reduce
+    values."""
     h = allreduce_async(tensor, average, name, op=op, compression=compression)
     _attach_post(h, inplace_dst=tensor)
     return h
@@ -311,7 +319,9 @@ def broadcast_(tensor, root_rank, name=None):
 
 def broadcast_async_(tensor, root_rank, name=None) -> int:
     """Async in-place broadcast (reference broadcast_async_):
-    ``synchronize(handle)`` writes the root's values into ``tensor``."""
+    ``synchronize(handle)`` writes the root's values into ``tensor``.
+    As with ``allreduce_async_``, the write happens AT ``synchronize`` —
+    a completed ``poll`` alone does not update ``tensor``."""
     h = broadcast_async(tensor, root_rank, name)
     _attach_post(h, inplace_dst=tensor)
     return h

@@ -136,7 +136,8 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
 
     Must run before the first compilation; safe to call repeatedly.  A jax
     without the knob (or a read-only path) degrades to per-process
-    compiles silently — callers never depend on the cache for correctness.
+    compiles with a one-line ``RuntimeWarning`` breadcrumb — callers never
+    depend on the cache for correctness.
     """
     path = os.environ.get("HVD_TPU_BENCH_CACHE") or default_dir
     if not path:
@@ -171,5 +172,14 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
         jax.config.update(
             "jax_compilation_cache_dir", os.path.join(path, host_key))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    except Exception as e:  # pragma: no cover - depends on jax version
+        # Read-only paths degrade silently by design, but a renamed jax
+        # config knob would ALSO land here and quietly disable the shared
+        # cache — leave one breadcrumb instead of nothing.
+        import warnings
+
+        warnings.warn(
+            f"persistent compile cache disabled ({type(e).__name__}: {e})",
+            RuntimeWarning,
+            stacklevel=2,
+        )

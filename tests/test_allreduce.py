@@ -55,6 +55,38 @@ def test_allreduce_min_max_product():
     np.testing.assert_allclose(np.asarray(prod), [2.0 ** hvd.size()])
 
 
+@pytest.mark.parametrize("shape,axes", [((5,), ("x",)), ((2, 4), ("x", "y"))])
+def test_product_ring_and_tuple_axis(shape, axes):
+    """_pprod's non-butterfly paths: a 5-rank axis takes the ring (n-1
+    shift-by-one ppermutes), a (2, 4) mesh takes the per-axis recursion —
+    both must equal the exact product with O(1) extra memory (no
+    all_gather in the lowering)."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops import collective_ops as co
+
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    mesh = Mesh(devs, axes)
+    n = devs.size
+    vals = np.arange(1, n + 1, dtype=np.float32)  # distinct per rank
+    x = jax.device_put(
+        vals.reshape(shape + (1,)),
+        jax.sharding.NamedSharding(mesh, P(*axes)),
+    )
+    axis = axes[0] if len(axes) == 1 else axes
+    f = shard_map(
+        lambda t: co._reduce(t, co.Product, axis),
+        mesh=mesh,
+        in_specs=P(*axes),
+        out_specs=P(*axes),
+    )
+    out = np.asarray(jax.jit(f)(x))
+    np.testing.assert_allclose(out, np.full_like(out, np.prod(vals)))
+    hlo = jax.jit(f).lower(x).compile().as_text()
+    assert "all-gather" not in hlo
+
+
 @pytest.mark.parametrize("comp", [hvd.Compression.fp16, hvd.Compression.bf16])
 def test_allreduce_compressed_roundtrip(comp):
     """fp16 compression round-trip (reference test_tensorflow.py:626-665):
