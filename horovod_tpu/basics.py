@@ -14,13 +14,22 @@ Horovod concept      TPU-native equivalent
 ==================  ==========================================================
 world (all ranks)    all devices of the global ``Mesh`` (axis ``"hvd"``)
 ``size()``           global device count (chips == Horovod ranks)
-``local_size()``     ``jax.local_device_count()``
+``local_size()``     chips driven from THIS host (all processes sharing it)
 ``rank()``           global index of this process's first device
-``local_rank()``     always 0 for the controller process (device pinning is
-                     handled by the runtime, not the user)
+``local_rank()``     index of this process's first chip among the host's
+                     chips — {0..nproc-1} for one-process-per-chip gangs,
+                     0 for a single controller process
 ``cross_size()``     ``jax.process_count()``   (number of hosts)
 ``cross_rank()``     ``jax.process_index()``   (this host's index)
 ==================  ==========================================================
+
+``local_rank``/``local_size`` follow the reference's per-host communicator
+(operations.cc:1558-1590, ``MPI_COMM_TYPE_SHARED``): processes are grouped
+by physical host.  The topology source is layered — the launcher's
+``HOROVOD_TPU_LOCAL_RANK``/``HOROVOD_TPU_LOCAL_SIZE`` env when present
+(it knows the per-host process layout it spawned), else a hostname
+exchange over the ``jax.distributed`` key-value store for externally
+launched multi-process gangs, else the single-controller identity.
 
 Inside compiled SPMD code (``shard_map`` over the mesh) the *per-chip* rank is
 ``jax.lax.axis_index("hvd")`` — exposed here as :func:`axis_rank`.
@@ -71,6 +80,8 @@ class _State:
         self.config: EngineConfig = EngineConfig()
         self.engine = None  # lazily created EagerEngine
         self.timeline = None  # lazily created Timeline
+        # (local_rank, local_size) — resolved lazily, cached per init()
+        self.local_topology: tuple[int, int] | None = None
 
 
 _state = _State()
@@ -112,6 +123,107 @@ def _maybe_init_distributed() -> None:
                 "distributed runtime can be set up first."
             ) from e
         _distributed_initialized = True
+
+
+def _my_mesh_device_count(st: "_State") -> int:
+    return sum(
+        1 for d in st.mesh.devices.flat
+        if d.process_index == jax.process_index()
+    )
+
+
+def _post_host_card(st: "_State") -> None:
+    """Publish this process's ``hostname|mesh_device_count`` card to the
+    ``jax.distributed`` key-value store so every peer can group ranks by
+    physical host — the TPU-native stand-in for the reference's
+    ``MPI_Comm_split_type(MPI_COMM_TYPE_SHARED)`` local communicator
+    (reference operations.cc:1558-1590).  Posted once at ``init()`` AFTER
+    the mesh is built (device-subset worlds advertise their mesh share,
+    not the raw device count, so per-host local_size sums to size());
+    reads happen lazily at the first ``local_rank()``/``local_size()``
+    call.  Best-effort: without a distributed client (single process) or
+    on a jax whose internal client API moved, the layered fallback in
+    ``_local_topology`` takes over."""
+    try:
+        from jax._src.distributed import global_state
+
+        client = global_state.client
+        if client is None:
+            return
+        import socket
+
+        client.key_value_set(
+            f"horovod_tpu/hostcard/{jax.process_index()}",
+            f"{socket.gethostname()}|{_my_mesh_device_count(st)}",
+            allow_overwrite=True,  # re-init may change the mesh subset
+        )
+    except Exception:
+        pass
+
+
+def _kv_topology() -> tuple[int, int] | None:
+    """Group processes by host via the cards ``_post_host_card`` published.
+
+    Returns ``(local_rank, local_size)`` in CHIP units: local_size is the
+    total device count across the host's processes, local_rank the number
+    of devices owned by lower-ranked processes on the same host — which
+    reduces to process indices {0..n-1} under one-process-per-chip, and to
+    (0, n_chips) under one-controller-per-host.
+
+    One ``key_value_dir_get`` poll loop, not per-process blocking gets: a
+    pod-scale gang fetches every card in O(1) round-trips per poll, and a
+    peer that never posts (mixed versions) costs one shared deadline
+    before the fallback — not a 60 s stall per missing key."""
+    try:
+        import time
+
+        from jax._src.distributed import global_state
+
+        client = global_state.client
+        n = jax.process_count()
+        if client is None or n <= 1:
+            return None
+        deadline = time.monotonic() + 60.0
+        while True:
+            entries = client.key_value_dir_get("horovod_tpu/hostcard/")
+            if len(entries) >= n:
+                break
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.1)
+        cards: dict[int, tuple[str, int]] = {}
+        for key, raw in entries:
+            host, ndev = raw.rsplit("|", 1)
+            cards[int(key.rsplit("/", 1)[1])] = (host, int(ndev))
+        me = jax.process_index()
+        my_host = cards[me][0]
+        before = sum(
+            nd for i, (h, nd) in cards.items() if h == my_host and i < me
+        )
+        total = sum(nd for h, nd in cards.values() if h == my_host)
+        return before, total
+    except Exception:
+        return None
+
+
+def _local_topology(st: "_State") -> tuple[int, int]:
+    """Resolve (local_rank, local_size), layered: launcher env (exact for
+    the one-device-per-process model the launcher spawns — ignored when
+    this process drives several chips, where process units would
+    under-count) → KV-store host grouping → single-controller identity."""
+    if st.local_topology is not None:
+        return st.local_topology
+    lr = os.environ.get("HOROVOD_TPU_LOCAL_RANK")
+    ls = os.environ.get("HOROVOD_TPU_LOCAL_SIZE")
+    topo = None
+    if lr is not None and ls is not None and _my_mesh_device_count(st) == 1:
+        topo = (int(lr), int(ls))
+    if topo is None:
+        topo = _kv_topology()
+    if topo is None:
+        topo = (0, _my_mesh_device_count(st))
+    st.local_topology = topo
+    return topo
 
 
 def _honor_platform_env() -> None:
@@ -169,6 +281,8 @@ def init(
 
             _state.mesh = Mesh(np.asarray(devs), (AXIS_NAME,))
         _state.config = EngineConfig.from_env()
+        _state.local_topology = None
+        _post_host_card(_state)
         _state.initialized = True
         _state.shut_down = False
     atexit.register(shutdown)
@@ -189,6 +303,7 @@ def shutdown() -> None:
         _state.shut_down = True
         _state.initialized = False
         _state.mesh = None
+        _state.local_topology = None
     if engine is not None:
         engine.shutdown()
     if timeline is not None:
@@ -223,10 +338,12 @@ def size() -> int:
 
 
 def local_size() -> int:
-    """Chips driven by this host (reference operations.cc:2069-2073)."""
-    st = _require_init()
-    local = [d for d in st.mesh.devices.flat if d.process_index == jax.process_index()]
-    return len(local)
+    """Chips driven from this HOST — all its processes together
+    (reference operations.cc:2069-2073: the per-host communicator's size).
+    One-process-per-chip gangs see the host's process count; a single
+    controller sees its own device count.  Topology resolution order is
+    documented in the module docstring."""
+    return _local_topology(_require_init())[1]
 
 
 def rank() -> int:
@@ -240,10 +357,13 @@ def rank() -> int:
 
 
 def local_rank() -> int:
-    """Always 0 on the controller process (reference operations.cc:2057-2061;
-    device pinning is owned by the TPU runtime, not user code)."""
-    _require_init()
-    return 0
+    """Index of this process's first chip among the host's chips
+    (reference operations.cc:2057-2061: rank in the per-host communicator).
+    {0..nproc-1} under the one-process-per-chip model the torch frontend
+    uses — so reference-style per-host logic ("first process on host",
+    data staggering, per-host caching) ports unchanged; 0 for a single
+    controller process (device pinning is owned by the TPU runtime)."""
+    return _local_topology(_require_init())[0]
 
 
 def cross_size() -> int:

@@ -135,6 +135,11 @@ def _run_gang(args, cmd, world: int, coordinator: str,
             HOROVOD_TPU_NUM_PROCESSES=str(world),
             HOROVOD_TPU_PROCESS_ID=str(pid),
             HOROVOD_TPU_CONTROLLER_TRANSPORT=transport,
+            # Per-host topology (reference MPI_COMM_TYPE_SHARED split,
+            # operations.cc:1558-1590): the launcher spawned exactly
+            # --nproc workers on this host, so it is the authority.
+            HOROVOD_TPU_LOCAL_RANK=str(i),
+            HOROVOD_TPU_LOCAL_SIZE=str(args.nproc),
         )
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"

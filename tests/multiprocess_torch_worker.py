@@ -28,6 +28,12 @@ def main() -> None:
     n = hvd.size()
     me = hvd.rank()
     assert n == 2, n
+    # Per-host topology under the reference's one-process-per-chip model
+    # (operations.cc:1558-1590): both workers share this host, so
+    # local_rank must be the process's index and local_size the process
+    # count — NOT the old hardwired (0, 1).
+    assert hvd.local_size() == n, hvd.local_size()
+    assert hvd.local_rank() == me, hvd.local_rank()
 
     # --- allreduce: average and sum of per-rank tensors.
     t = torch.arange(4, dtype=torch.float32) + me

@@ -40,6 +40,12 @@ def main() -> None:
     me = jax.process_index()
     assert hvd.cross_size() == int(os.environ["HOROVOD_TPU_NUM_PROCESSES"])
     assert hvd.cross_rank() == int(os.environ["HOROVOD_TPU_PROCESS_ID"])
+    # --- per-host topology (reference operations.cc:1558-1590): every
+    # worker here shares one host and drives one device, so local == global
+    # whichever source resolved it (launcher env when launched by
+    # horovod_tpu.launch, KV-store hostname exchange when spawned raw).
+    assert hvd.local_size() == n, (hvd.local_size(), n)
+    assert hvd.local_rank() == hvd.rank(), (hvd.local_rank(), hvd.rank())
 
     # --- broadcast_parameters from process-0-owned root (fast path).
     params = {
