@@ -566,10 +566,67 @@ def _bench_fusion(hvd, on_tpu: bool) -> dict:
         dt = (time.perf_counter() - t0) / rounds
         return dt, int(hvd.engine_stats().get("tensors_fused", 0)) - fused0
 
+    def run_autotune() -> dict:
+        """On-chip autotuner trajectory (reference's HOROVOD_AUTOTUNE on
+        this workload): individual async allreduces (threshold-driven
+        bucketing — caller-delimited groups would bypass the knob), hill
+        climber scoring windows until it pins a winner or the arm budget
+        runs out.  Records the trajectory CSV tail and the (possibly
+        still-moving) threshold the tuner ended on."""
+        import tempfile
+
+        hvd.shutdown()
+        log = os.path.join(
+            tempfile.gettempdir(), f"hvd_bench_autotune_{os.getpid()}.csv"
+        )
+        os.environ["HOROVOD_AUTOTUNE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_LOG"] = log
+        os.environ["HOROVOD_CYCLE_TIME"] = "1"
+        os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
+        hvd.init()
+
+        def one_round(acc):
+            hs = [
+                hvd.allreduce_async(g, name=f"at.{i}", average=True)
+                for i, g in enumerate(grads)
+            ]
+            outs = [hvd.synchronize(h) for h in hs]
+            return digest(acc, outs)
+
+        _readback(one_round(jnp.float32(0)))          # warm compiles
+        from horovod_tpu.basics import _state
+
+        eng = _state.engine
+        arm_budget = float(os.environ.get("HVD_TPU_BENCH_AUTOTUNE_S", "45"))
+        acc = jnp.float32(0)
+        t0 = time.perf_counter()
+        r = 0
+        while time.perf_counter() - t0 < arm_budget and r < 400:
+            acc = one_round(acc)
+            r += 1
+            if r % 10 == 0:
+                _readback(acc)                        # keep windows honest
+            if eng.autotuner is not None and eng.autotuner.done:
+                break
+        _readback(acc)
+        tail: list[str] = []
+        try:
+            with open(log) as f:
+                tail = [ln.strip() for ln in f.readlines()][-8:]
+        except OSError:
+            pass
+        return {
+            "autotune_rounds": r,
+            "autotune_done": bool(eng.autotuner and eng.autotuner.done),
+            "autotune_threshold_bytes": eng.config.fusion_threshold_bytes,
+            "autotune_cycle_ms": eng.config.cycle_time_ms,
+            "autotune_log": tail,
+        }
+
     try:
         fused_s, fused_count = run_config(str(64 * 1024 * 1024))
         unfused_s, unfused_count = run_config("0")
-        return {
+        out = {
             "fusion_speedup": round(unfused_s / fused_s, 3),
             "fused_ms": round(fused_s * 1e3, 2),
             "unfused_ms": round(unfused_s * 1e3, 2),
@@ -579,9 +636,14 @@ def _bench_fusion(hvd, on_tpu: bool) -> dict:
             "fused_arm_tensors_fused": fused_count,
             "unfused_arm_tensors_fused": unfused_count,
         }
+        if on_tpu or os.environ.get("HVD_TPU_BENCH_AUTOTUNE_ON_CPU") == "1":
+            out.update(run_autotune())
+        return out
     finally:
         os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
         os.environ.pop("HOROVOD_CYCLE_TIME", None)
+        os.environ.pop("HOROVOD_AUTOTUNE", None)
+        os.environ.pop("HOROVOD_AUTOTUNE_LOG", None)
         hvd.shutdown()
         hvd.init()
 

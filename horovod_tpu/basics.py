@@ -80,6 +80,7 @@ class _State:
         self.config: EngineConfig = EngineConfig()
         self.engine = None  # lazily created EagerEngine
         self.timeline = None  # lazily created Timeline
+        self.profiler_active = False  # start_timeline(profiler_dir=...)
         # (local_rank, local_size) — resolved lazily, cached per init()
         self.local_topology: tuple[int, int] | None = None
 
@@ -218,6 +219,13 @@ def _local_topology(st: "_State") -> tuple[int, int]:
     topo = None
     if lr is not None and ls is not None and _my_mesh_device_count(st) == 1:
         topo = (int(lr), int(ls))
+        world = st.mesh.devices.size
+        if not (0 <= topo[0] < topo[1] <= world):
+            # e.g. a launcher-spawned worker re-init()ed with a device
+            # subset: the launcher's process-unit numbers no longer
+            # describe this world (local_size would exceed size()).  Fall
+            # through to the KV cards, which count mesh shares.
+            topo = None
     if topo is None:
         topo = _kv_topology()
     if topo is None:
@@ -300,10 +308,19 @@ def shutdown() -> None:
             return
         engine, _state.engine = _state.engine, None
         timeline, _state.timeline = _state.timeline, None
+        profiling, _state.profiler_active = _state.profiler_active, False
         _state.shut_down = True
         _state.initialized = False
         _state.mesh = None
         _state.local_topology = None
+    if profiling:
+        # A start_timeline(profiler_dir=...) window left open at shutdown
+        # must still finalize the XLA profile (a dangling trace would make
+        # the next start_trace raise).
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
     if engine is not None:
         engine.shutdown()
     if timeline is not None:

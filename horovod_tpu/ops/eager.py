@@ -134,7 +134,16 @@ class EagerEngine:
         # pair mismatched messages ("received data size doesn't match").
         # Blocking per dispatch caps in-flight depth at 1; TPU's ordered
         # stream needs no throttle and keeps the async pipeline.
-        self._serialize_dispatch = jax.default_backend() == "cpu"
+        # HOROVOD_TPU_SERIALIZE_DISPATCH overrides: "off" tests the
+        # TPU-production pipelined path on the single-process virtual mesh
+        # (one controller ⇒ one launch covers all ranks, so CPU arrival
+        # order cannot diverge); "on" forces depth-1 on any backend.
+        if cfg.serialize_dispatch == "on":
+            self._serialize_dispatch = True
+        elif cfg.serialize_dispatch == "off":
+            self._serialize_dispatch = False
+        else:
+            self._serialize_dispatch = jax.default_backend() == "cpu"
         self._shutdown = threading.Event()
         self._tick = threading.Event()
         self.controller = self._maybe_native_controller(cfg)

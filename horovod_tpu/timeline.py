@@ -187,7 +187,8 @@ def maybe_create(path: str | None,
     return Timeline(path, mark_cycles=mark_cycles)
 
 
-def start_timeline(path: str, mark_cycles: bool = False) -> None:
+def start_timeline(path: str, mark_cycles: bool = False,
+                   profiler_dir: str | None = None) -> None:
     """Start recording a timeline mid-run — the ``hvd.start_timeline``
     API the Horovod project added in 0.20 (the reference generation could
     only enable it via env var at init).
@@ -195,6 +196,14 @@ def start_timeline(path: str, mark_cycles: bool = False) -> None:
     ``mark_cycles=True`` adds an instant event per engine cycle tick, the
     same knob as upstream.  Rank-0 only in multi-host jobs (no-op
     elsewhere); raises if a timeline is already active.
+
+    ``profiler_dir`` additionally captures a ``jax.profiler.trace`` for
+    the same window (SURVEY §5's TPU mapping of timeline.cc:24-188): the
+    engine's NEGOTIATE/DISPATCH phases land in the Chrome trace while the
+    device-side detail (per-HLO timing, ICI traffic) lands in the XLA
+    profile, and the ``trace_annotation`` bridge names line up across the
+    two in TensorBoard.  Stopped by ``stop_timeline``; rank-0 only, like
+    the timeline itself.
     """
     from horovod_tpu import basics
 
@@ -205,6 +214,16 @@ def start_timeline(path: str, mark_cycles: bool = False) -> None:
                 "a timeline is already active; call stop_timeline() first"
             )
         tl = maybe_create(path, mark_cycles=mark_cycles)
+        if tl is not None and profiler_dir:
+            # Before st.timeline is assigned: a start_trace failure (e.g. a
+            # user-started profiler session already active) must not leave
+            # a half-open timeline that start_timeline retries reject.
+            try:
+                jax.profiler.start_trace(profiler_dir)
+            except Exception:
+                tl.close()
+                raise
+            st.profiler_active = True
         st.timeline = tl
         if st.engine is not None and tl is not None:
             st.engine.timeline = tl
@@ -220,11 +239,26 @@ def stop_timeline() -> None:
     st = basics._require_init()
     with st.lock:
         tl, st.timeline = st.timeline, None
+        profiling, st.profiler_active = st.profiler_active, False
         if st.engine is not None:
             st.engine.timeline = None
             if st.engine.controller is not None and tl is not None:
                 # The drain site is gated on an active timeline; without
                 # this the rank-0 tick buffer would grow with no consumer.
                 st.engine.controller.enable_tick_trace(False)
+    if profiling:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - depends on jax state
+            # A profiler failure (xplane write error, trace already
+            # stopped by user code) must not lose the Chrome trace below.
+            import warnings
+
+            warnings.warn(
+                f"jax profiler stop failed ({type(e).__name__}: {e}); "
+                "the timeline file is still finalized",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     if tl is not None:
         tl.close()

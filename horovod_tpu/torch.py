@@ -30,7 +30,14 @@ import numpy as np
 import horovod_tpu as _hvd
 from horovod_tpu import basics as _basics
 from horovod_tpu.ops import eager as _eager
-from horovod_tpu.ops.collective_ops import Adasum, Average, Sum  # noqa: F401
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+)
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 
@@ -115,8 +122,8 @@ def _to_rank_major(t) -> Any:
                            or local.min() < -0x80000000):
             raise ValueError(
                 "int64 tensor holds values outside int32 range; the TPU "
-                "wire carries int32 (enable smaller dtypes or split the "
-                "value)"
+                "wire carries int32 (set HOROVOD_TPU_X64=1 for the exact "
+                "64-bit allreduce/broadcast path, or split the value)"
             )
     if _basics.size() == 1:
         return jax.device_put(local[None], _basics.rank_sharding())
@@ -155,13 +162,109 @@ def _note_wire_dtype(handle: int, tensor) -> int:
     return handle
 
 
+def _x64_enabled() -> bool:
+    """``HOROVOD_TPU_X64=1``: exact 64-bit allreduce/broadcast (reference
+    parity for MPI_LONG_LONG / MPI_DOUBLE wires, mpi_message.h:32,35 →
+    operations.cc:551-558).  Read at call time so tests and applications
+    can toggle per-op; parsed by the same rule as every other boolean
+    knob."""
+    from horovod_tpu.utils.env import _get_bool
+
+    return _get_bool("HOROVOD_TPU_X64")
+
+
+def _encode64(arr: np.ndarray) -> np.ndarray:
+    """int64/float64 payload → one (1, 2·numel) int32 bit-plane row.
+
+    The data plane stays 32-bit (jax x64 off — TPUs have no 64-bit
+    hardware path); exactness comes from moving the raw 64-bit bit
+    pattern as two little-endian int32 words per element and doing the
+    64-bit arithmetic on the host."""
+    flat = np.ascontiguousarray(arr.reshape(-1))
+    return flat.view(np.int32).reshape(1, -1)
+
+
+def _decode64(rows: np.ndarray, np_dtype, shape: tuple) -> np.ndarray:
+    """(world, 2·numel) int32 bit-planes → (world, *shape) 64-bit values."""
+    return (
+        np.ascontiguousarray(rows).view(np_dtype)
+        .reshape((rows.shape[0],) + tuple(shape))
+    )
+
+
+def _np_dtype64(torch_dtype):
+    torch = _torch()
+    return np.int64 if torch_dtype == torch.int64 else np.float64
+
+
+def _allreduce64_async(tensor, op, name, compression) -> int:
+    """Exact 64-bit allreduce: allgather the bit-planes through the engine
+    (so it negotiates/fuses/orders like every other op), reduce in 64-bit
+    on the host at ``synchronize``.  O(world) wire and host memory per
+    tensor — the int64-counter / fp64-scalar workloads this exists for
+    are small; large-model gradients belong on the 32/16-bit paths.
+    int64 Sum wraps mod 2⁶⁴ exactly like MPI's; int64 Average floors."""
+    torch = _torch()
+    if op not in (Sum, Average, Min, Max, Product):
+        raise ValueError(
+            f"HOROVOD_TPU_X64 allreduce supports Sum/Average/Min/Max/"
+            f"Product, not {op}"
+        )
+    if compression is not Compression.none:
+        raise ValueError(
+            "HOROVOD_TPU_X64 is the exact 64-bit path; lossy compression "
+            "contradicts it — use the default 32-bit wire instead"
+        )
+    planes = torch.from_numpy(_encode64(_torch_to_np(tensor)).copy())
+    h = _eager.allgather_async(_to_rank_major(planes), name=name)
+    _attach_post(
+        h, x64_reduce=(op, tensor.dtype, tuple(tensor.shape))
+    )
+    return h
+
+
 def allreduce_async(tensor, average=True, name=None, *, op=None,
                     compression=Compression.none) -> int:
+    torch = _torch()
     if op is None:
         op = Average if average else Sum
+    if tensor.dtype in (torch.int64, torch.float64) and _x64_enabled():
+        return _allreduce64_async(tensor, op, name, compression)
+    guard_h = None
+    if (tensor.dtype == torch.int64 and op in (Sum, Average)
+            and _basics.size() > 1):
+        # The wire is int32: inputs that individually fit can still
+        # overflow mid-reduce.  Guard with the sound per-rank bound
+        # |v| <= int32_max / world — but checked COLLECTIVELY (a Max
+        # allreduce of each rank's |v|max): the values differ per rank, so
+        # a local raise would diverge — one rank erroring while its peers
+        # sit in the posted collective until the stall watchdog fires.
+        # Every rank enqueues the probe, every rank sees the global
+        # maximum at synchronize, and all raise (or none do) together.
+        # Single-rank worlds skip the probe: nothing to desynchronize, no
+        # cross-rank sum, and _to_rank_major's range check already covers
+        # out-of-int32 inputs.  The escape hatch is HOROVOD_TPU_X64.
+        absmax = 0
+        if tensor.numel():
+            absmax = max(abs(int(tensor.max())), abs(int(tensor.min())))
+        probe = torch.tensor([min(absmax, 0x7FFFFFFF)], dtype=torch.int32)
+        guard_h = _eager.allreduce_async(
+            _to_rank_major(probe),
+            name=f"{name}.x64guard" if name else None,
+            op=Max,
+        )
+        if absmax > 0x7FFFFFFF:
+            # Values beyond the int32 wire entirely: a local raise would
+            # diverge, so ship a wire-valid clamped payload and let the
+            # guard — whose clamped probe always exceeds the bound —
+            # raise on every rank at synchronize; the result is discarded.
+            tensor = tensor.clamp(-0x80000000, 0x7FFFFFFF)
     h = _eager.allreduce_async(
         _to_rank_major(tensor), name=name, op=op, compression=compression
     )
+    if guard_h is not None:
+        bound = 0x7FFFFFFF // max(_basics.size(), 1)
+        _attach_post(h, x64_guard=(guard_h, bound, str(op)))
     return _note_wire_dtype(h, tensor)
 
 
@@ -303,6 +406,16 @@ def alltoall(tensor, name=None):
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
+    torch = _torch()
+    if tensor.dtype in (torch.int64, torch.float64) and _x64_enabled():
+        # Exact 64-bit broadcast: ship the bit-planes, decode at
+        # synchronize.  Lifts the int32-range input validation the
+        # narrowed wire needs.
+        planes = torch.from_numpy(_encode64(_torch_to_np(tensor)).copy())
+        h = _eager.broadcast_async(_to_rank_major(planes), root_rank,
+                                   name=name)
+        _attach_post(h, x64_bcast=(tensor.dtype, tuple(tensor.shape)))
+        return h
     h = _eager.broadcast_async(_to_rank_major(tensor), root_rank, name=name)
     return _note_wire_dtype(h, tensor)
 
@@ -368,6 +481,21 @@ def synchronize(handle: int):
     # payload is already off the entry and the entry itself is released by
     # the manager's error path — nothing to leak either way.
     post = _eager.take_handle_post(handle) or {}
+    guard = post.get("x64_guard")
+    if guard is not None:
+        # The collective overflow probe for an int64 Sum/Average on the
+        # int32 wire: every rank sees the same global |v|max, so this
+        # raise happens on ALL ranks or none — never a divergent hang.
+        guard_h, bound, op_name = guard
+        gmax = int(np.asarray(_eager.synchronize(guard_h)).max())
+        if gmax > bound:
+            _eager.release(handle)
+            raise ValueError(
+                f"int64 {op_name} allreduce may overflow the int32 wire "
+                f"(a rank holds |value| {gmax} > bound {bound} for world "
+                f"size {_basics.size()}); set HOROVOD_TPU_X64=1 for the "
+                "exact 64-bit path"
+            )
     raw = _eager.synchronize(handle)
     torch = _torch()
     if post.get("rank_major"):
@@ -382,6 +510,33 @@ def synchronize(handle: int):
                 [out[r * pad:r * pad + s] for r, s in enumerate(sizes)],
                 dim=0,
             )
+    x64r = post.get("x64_reduce")
+    if x64r is not None:
+        op, want_dtype, shape = x64r
+        rows = out.numpy()            # (world, 2·numel) int32 bit-planes
+        vals = _decode64(rows, _np_dtype64(want_dtype), shape)
+        n = vals.shape[0]
+        if op is Sum:
+            red = vals.sum(axis=0)
+        elif op is Average:
+            s = vals.sum(axis=0)
+            red = s // n if vals.dtype == np.int64 else s / n
+        elif op is Min:
+            red = vals.min(axis=0)
+        elif op is Max:
+            red = vals.max(axis=0)
+        else:                         # Product (validated at enqueue)
+            red = vals.prod(axis=0)
+        out = torch.from_numpy(np.ascontiguousarray(red).reshape(shape))
+    x64b = post.get("x64_bcast")
+    if x64b is not None:
+        want_dtype, shape = x64b
+        rows = out.numpy().reshape(1, -1)
+        # np.array: a 0-dim payload indexes out as a numpy scalar, which
+        # torch.from_numpy refuses.
+        out = torch.from_numpy(
+            np.array(_decode64(rows, _np_dtype64(want_dtype), shape)[0])
+        )
     want = post.get("dtype")
     if want is not None and out.dtype != want:
         out = out.to(want)

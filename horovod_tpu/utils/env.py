@@ -60,6 +60,28 @@ def _get_bool(name: str, default: bool = False) -> bool:
     return raw.strip().lower() not in ("", "0", "false", "no", "off")
 
 
+def _get_tristate(name: str) -> str:
+    """on/off/auto knob, accepting the same truthy/falsy spellings as
+    ``_get_bool`` (so ``=1`` forces on, like every other knob); an
+    unrecognized value warns and falls back to auto instead of silently
+    misconfiguring."""
+    raw = os.environ.get(name, "auto").strip().lower()
+    if raw in ("on", "1", "true", "yes"):
+        return "on"
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw in ("auto", ""):
+        return "auto"
+    import warnings
+
+    warnings.warn(
+        f"{name}={raw!r} not recognized (want on/off/auto); using auto",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+    return "auto"
+
+
 @dataclasses.dataclass
 class EngineConfig:
     """Snapshot of all engine knobs, taken once when the engine starts.
@@ -94,6 +116,12 @@ class EngineConfig:
     autotune_log: str | None = None
     autotune_warmup_samples: int = 3
     autotune_steady_state_samples: int = 10
+    # Dispatch serialization: "auto" blocks per launch on the CPU backend
+    # only (multi-controller CPU collectives are matched by arrival order
+    # — concurrent launches can pair mismatched messages); "off" keeps the
+    # TPU-style async pipeline everywhere (safe single-process, where one
+    # launch covers all ranks); "on" forces depth-1 even on TPU.
+    serialize_dispatch: str = "auto"
 
     @classmethod
     def from_env(cls) -> "EngineConfig":
@@ -121,6 +149,9 @@ class EngineConfig:
             autotune=_get_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG) or None,
             autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            serialize_dispatch=_get_tristate(
+                "HOROVOD_TPU_SERIALIZE_DISPATCH"
+            ),
             autotune_steady_state_samples=_get_int(
                 HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES, 10
             ),

@@ -1,0 +1,95 @@
+"""Worker: TWO controllers × TWO devices each — the real pod shape (one
+process per host, several chips per process), simulated on CPU.
+
+Every other multi-process scenario drives 1 device per process; this one
+exercises the paths only a multi-chip controller takes: ``rank()`` as the
+global index of the process's FIRST device, chip-unit
+``local_rank``/``local_size`` summed across the host's processes,
+``make_array_from_process_local_data`` with multi-row process-local
+shards, and caller-delimited fusion groups negotiated between two
+controllers that each speak for two chips.
+
+Reference analogue: a 2-node × 2-GPU mpirun job (reference
+docs/benchmarks.md topology), except the reference runs 4 processes — the
+TPU-native model runs one controller per host.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    pid = jax.process_index()
+    n = hvd.size()
+    assert n == 4, n
+    assert jax.local_device_count() == 2
+    # rank(): global index of this process's first device.
+    assert hvd.rank() == 2 * pid, (hvd.rank(), pid)
+    # Chip-unit per-host topology via the KV hostname exchange: both
+    # processes share this host, so the host drives all 4 chips and this
+    # process's first chip sits after the 2 chips of lower-ranked peers.
+    assert hvd.local_size() == 4, hvd.local_size()
+    assert hvd.local_rank() == 2 * pid, hvd.local_rank()
+    assert hvd.cross_size() == 2 and hvd.cross_rank() == pid
+
+    # --- rank-major arrays from multi-row process-local shards.
+    rows = np.stack(
+        [np.full((3,), 2 * pid + i, np.float32) for i in range(2)]
+    )
+    x = jax.make_array_from_process_local_data(hvd.rank_sharding(), rows)
+    out = np.asarray(hvd.allreduce(x, average=False, name="md.sum"))
+    assert np.allclose(out, np.full((3,), 6.0)), out  # 0+1+2+3
+
+    # --- caller-delimited fusion: one bucket, several tensors, negotiated
+    # between two controllers that each own two chips.
+    group = [
+        jax.make_array_from_process_local_data(
+            hvd.rank_sharding(),
+            np.stack(
+                [np.full((4,), float(10 * k + 2 * pid + i), np.float32)
+                 for i in range(2)]
+            ),
+        )
+        for k in range(3)
+    ]
+    outs = hvd.grouped_allreduce_eager(group)
+    for k, o in enumerate(outs):
+        want = sum(10.0 * k + r for r in range(4))
+        assert np.allclose(np.asarray(o), np.full((4,), want)), (k, o)
+
+    # --- broadcast from a root chip owned by the OTHER controller.
+    b = hvd.broadcast(x, root_rank=3, name="md.bcast")
+    assert np.allclose(np.asarray(b), np.full((3,), 3.0)), b
+
+    # --- async interleaving across the two controllers.
+    hs = [
+        hvd.allreduce_async(x, average=True, name=f"md.async{i}")
+        for i in range(4)
+    ]
+    for h in reversed(hs):
+        got = np.asarray(hvd.synchronize(h))
+        assert np.allclose(got, np.full((3,), 1.5)), got
+
+    hvd.shutdown()
+    print("MULTIDEV_OK " + json.dumps({"pid": pid, "size": n}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -261,6 +261,50 @@ def main() -> None:
                        name="t.bc.bool")
     assert bl.dtype == torch.bool and bl.tolist() == [True, True, False], bl
 
+    # --- 64-bit wire (reference mpi_message.h:32,35 — MPI_LONG_LONG /
+    # MPI_DOUBLE end-to-end).  Default mode: a Sum that cannot fit the
+    # int32 wire must be REJECTED with a pointer to the escape hatch —
+    # both for out-of-range inputs and for in-range inputs whose
+    # cross-rank Sum overflows mid-wire.
+    big = torch.tensor([2 ** 33 + me, -(2 ** 35) + me, 7])
+    try:
+        hvd.allreduce(big, average=False, name="t.x64.reject")
+        raise AssertionError("int64 out-of-range Sum not rejected")
+    except ValueError as e:
+        assert "HOROVOD_TPU_X64" in str(e), e
+    try:
+        hvd.allreduce(torch.tensor([0x7FFFFFF0]), average=False,
+                      name="t.x64.guard")
+        raise AssertionError("int32 mid-wire Sum overflow not guarded")
+    except ValueError as e:
+        assert "overflow" in str(e), e
+    # HOROVOD_TPU_X64=1: the exact 64-bit path (bit-planes + host reduce).
+    os.environ["HOROVOD_TPU_X64"] = "1"
+    try:
+        s64 = hvd.allreduce(big, average=False, name="t.x64.sum")
+        assert s64.dtype == torch.int64
+        assert torch.equal(
+            s64, torch.tensor([2 ** 34 + 1, -(2 ** 36) + 1, 14])
+        ), s64
+        # float64 at FULL precision: a delta float32 cannot represent.
+        f = torch.tensor([1.0 + 2.0 ** -40 * (me + 1)], dtype=torch.float64)
+        fs = hvd.allreduce(f, average=True, name="t.x64.f64")
+        assert fs.dtype == torch.float64
+        assert abs(float(fs) - (1.0 + 2.0 ** -40 * 1.5)) < 1e-15, fs
+        m = hvd.allreduce(torch.tensor([2 ** 40 * (me + 1)]), op=hvd.Min,
+                          name="t.x64.min")
+        assert int(m) == 2 ** 40, m
+        bc = hvd.broadcast(torch.tensor([2 ** 45 + me]), 0, name="t.x64.bc")
+        assert int(bc) == 2 ** 45, bc
+        sb64 = hvd.broadcast(torch.tensor(2 ** 40 + me), 0,
+                             name="t.x64.scalar")      # 0-dim int64
+        assert sb64.shape == () and int(sb64) == 2 ** 40, sb64
+        ip = torch.tensor([2 ** 33])
+        hh = hvd.allreduce_async_(ip, average=False, name="t.x64.ip")
+        assert hvd.synchronize(hh) is ip and int(ip) == 2 ** 34, ip
+    finally:
+        del os.environ["HOROVOD_TPU_X64"]
+
     # --- Scalar + int64 round-trip: a state_dict broadcast carries 0-dim
     # LongTensors (BatchNorm num_batches_tracked); shape AND dtype must
     # survive the int32 wire (regression: ascontiguousarray 0-dim

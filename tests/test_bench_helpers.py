@@ -144,3 +144,22 @@ def test_generate_arm_rehearsal_path(bench, monkeypatch):
     assert out["generate_tokens_per_sec_per_chip"] > 0
     assert out["generate_ms_per_new_token"] > 0
     assert out["generate_shape"] == "b2_prompt8_new8"
+
+
+def test_bench_fusion_autotune_arm_cpu(bench, monkeypatch):
+    """The fusion A/B plus the autotuner-trajectory arm (VERDICT r3 #2's
+    converged-threshold record) runs end-to-end on the CPU stand-in: both
+    A/B arms report, the autotune arm completes some rounds, and the
+    trajectory/threshold fields land in the extras dict."""
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HVD_TPU_BENCH_FUSION_ON_CPU", "1")
+    monkeypatch.setenv("HVD_TPU_BENCH_AUTOTUNE_ON_CPU", "1")
+    monkeypatch.setenv("HVD_TPU_BENCH_AUTOTUNE_S", "5")
+    monkeypatch.setenv("HVD_TPU_BENCH_FUSION_ROUNDS", "2")
+    out = bench._bench_fusion(hvd, on_tpu=False)
+    assert out["fused_ms"] > 0 and out["unfused_ms"] > 0
+    assert out["fused_arm_tensors_fused"] > 0
+    assert out["autotune_rounds"] >= 1
+    assert out["autotune_threshold_bytes"] > 0
+    assert isinstance(out["autotune_log"], list)

@@ -92,6 +92,58 @@ def test_engine_random_interleaving_tiny_threshold(monkeypatch):
         hvd.init()
 
 
+def test_engine_random_interleaving_pipelined_dispatch(monkeypatch):
+    """The TPU-production dispatch mode: HOROVOD_TPU_SERIALIZE_DISPATCH=off
+    keeps multiple collective launches in flight, covering the dispatch
+    false-branches (no block_until_ready per launch) that the 'auto' CPU
+    default never takes.  Safe on this harness: a single process drives
+    all 8 virtual ranks, so one launch covers every rank and CPU arrival
+    order cannot diverge."""
+    try:
+        monkeypatch.setenv("HOROVOD_TPU_SERIALIZE_DISPATCH", "off")
+        hvd.shutdown()
+        hvd.init()
+        for seed in (9, 27):
+            test_engine_random_interleaving(seed)
+        from horovod_tpu.basics import _state
+
+        assert _state.engine is not None
+        assert _state.engine._serialize_dispatch is False
+    finally:
+        monkeypatch.undo()
+        hvd.shutdown()
+        hvd.init()
+
+
+def test_engine_pipelined_dispatch_native_controller(monkeypatch):
+    """Pipelined dispatch × native control plane — the closest this
+    harness gets to the real TPU production configuration (async launch
+    depth > 1 behind controller-negotiated batches)."""
+    import uuid
+
+    from horovod_tpu import native
+
+    if not native.available():
+        pytest.skip("libhvdtpu.so unavailable")
+    try:
+        monkeypatch.setenv("HOROVOD_TPU_SERIALIZE_DISPATCH", "off")
+        monkeypatch.setenv("HOROVOD_TPU_NATIVE_CONTROLLER", "on")
+        monkeypatch.setenv(
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT", f"local:{uuid.uuid4().hex}"
+        )
+        hvd.shutdown()
+        hvd.init()
+        test_engine_random_interleaving(31)
+        from horovod_tpu.basics import _state
+
+        assert _state.engine.controller is not None
+        assert _state.engine._serialize_dispatch is False
+    finally:
+        monkeypatch.undo()
+        hvd.shutdown()
+        hvd.init()
+
+
 def test_engine_random_interleaving_native_controller(monkeypatch):
     """The chaos sweep through the native C++ controller (gather→match→
     fuse→bcast in controller.cc) instead of the in-process Python

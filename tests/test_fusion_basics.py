@@ -310,6 +310,35 @@ def test_start_stop_timeline_mid_run(tmp_path):
     assert "before.rec" not in tracked and "after.rec" not in tracked
 
 
+def test_start_timeline_with_jax_profiler_bridge(tmp_path):
+    """start_timeline(profiler_dir=...) captures a jax.profiler trace for
+    the SAME window as the Chrome trace (SURVEY §5's TPU mapping of
+    timeline.cc:24-188): the .xplane.pb lands under the profiler dir and
+    the timeline file stays valid, so NEGOTIATE phases and device-side
+    detail can be lined up in TensorBoard."""
+    import glob
+    import json
+
+    import horovod_tpu as hvd
+
+    path = tmp_path / "combined.json"
+    prof = tmp_path / "xprof"
+    x = hvd.per_rank(lambda r: jnp.full((3,), float(r)))
+    hvd.start_timeline(str(path), profiler_dir=str(prof))
+    try:
+        hvd.allreduce(x, name="prof.rec")
+    finally:
+        hvd.stop_timeline()
+    events = json.loads(path.read_text())
+    assert any(e["name"] == "NEGOTIATE_ALLREDUCE" for e in events)
+    planes = glob.glob(str(prof / "**" / "*.xplane.pb"), recursive=True)
+    assert planes, f"no xplane capture under {prof}"
+    # The window is closed: a fresh profiler trace can start again.
+    hvd.start_timeline(str(tmp_path / "t2.json"),
+                       profiler_dir=str(tmp_path / "xprof2"))
+    hvd.stop_timeline()
+
+
 def test_timeline_schema_end_to_end(tmp_path, monkeypatch):
     """Drive real ops through the engine with a timeline attached, then
     validate the emitted file against the Chrome-trace event schema

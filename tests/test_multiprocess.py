@@ -436,6 +436,24 @@ def test_gang4_ragged_process_sets_restart(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_controllers_two_devices_each():
+    """VERDICT r3 #7: the real pod shape — 2 processes × 2 virtual CPU
+    devices each (multi-chip controllers), exercising rank()/local_*,
+    make_array_from_process_local_data with multi-row shards, and
+    caller-delimited fusion across controllers."""
+    outs = _run_workers(
+        os.path.join(HERE, "multiprocess_multidev_worker.py"), 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    )
+    for i, out in enumerate(outs):
+        assert "MULTIDEV_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
 def test_launcher_local_topology_four_process_single_host(tmp_path):
     """VERDICT r3 #4: a 4-process single-host gang must see local_ranks
     {0,1,2,3} and local_size 4 through BOTH frontends (the reference's
