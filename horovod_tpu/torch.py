@@ -463,13 +463,38 @@ def grouped_allreduce(tensors, average=True, *, op=None,
                       compression=Compression.none):
     """Allreduce many tensors as one fusion group (the grouped API later
     Horovod grew in 0.21) — one caller-delimited bucket through the
-    engine, deterministic across hosts."""
+    engine, deterministic across hosts.
+
+    64-bit tensors take the same paths as ``allreduce``: int64 (and, with
+    ``HOROVOD_TPU_X64``, float64) members are split out of the bucket and
+    ride the per-tensor path, so the collective overflow guard and the
+    exact bit-plane wire apply to grouped calls too — a bucket position
+    costs nothing for ops that would otherwise wrap silently mid-wire."""
+    torch = _torch()
     if op is None:
         op = Average if average else Sum
+    x64 = _x64_enabled()
+    routed = {
+        i: t for i, t in enumerate(tensors)
+        if t.dtype == torch.int64 or (x64 and t.dtype == torch.float64)
+    }
+    handles = {
+        i: allreduce_async(t, name=f"grouped.{i}", op=op,
+                           compression=compression)
+        for i, t in routed.items()
+    }
+    bucket = [t for i, t in enumerate(tensors) if i not in routed]
     outs = _eager.grouped_allreduce_eager(
-        [_to_rank_major(t) for t in tensors], op=op, compression=compression
-    )
-    return [_to_torch(o) for o in outs]
+        [_to_rank_major(t) for t in bucket], op=op, compression=compression
+    ) if bucket else []
+    results: list = []
+    it = iter(outs)
+    for i in range(len(tensors)):
+        if i in handles:
+            results.append(synchronize(handles[i]))
+        else:
+            results.append(_to_torch(next(it)))
+    return results
 
 
 def poll(handle: int) -> bool:

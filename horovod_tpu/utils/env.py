@@ -25,6 +25,7 @@ HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
 HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES = "HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES"
+HOROVOD_TPU_SERIALIZE_DISPATCH = "HOROVOD_TPU_SERIALIZE_DISPATCH"
 
 # Defaults mirror reference horovod/common/operations.cc:151 (64 MiB fusion
 # buffer), :155 (5 ms cycle) and :273 (60 s stall warning).
@@ -149,9 +150,7 @@ class EngineConfig:
             autotune=_get_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG) or None,
             autotune_warmup_samples=_get_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
-            serialize_dispatch=_get_tristate(
-                "HOROVOD_TPU_SERIALIZE_DISPATCH"
-            ),
+            serialize_dispatch=_get_tristate(HOROVOD_TPU_SERIALIZE_DISPATCH),
             autotune_steady_state_samples=_get_int(
                 HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES, 10
             ),

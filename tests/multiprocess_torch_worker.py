@@ -116,6 +116,26 @@ def main() -> None:
     for i, g in enumerate(group):
         assert torch.allclose(g, torch.full((4,), 0.5 + i)), (i, g)
 
+    # --- grouped with 64-bit members: int64 splits out of the bucket onto
+    # the guarded per-tensor path (exact under X64; symmetric overflow
+    # raise in default mode) while float32 members keep the bucket.
+    os.environ["HOROVOD_TPU_X64"] = "1"
+    try:
+        gmix = hvd.grouped_allreduce(
+            [torch.full((4,), float(me)), torch.tensor([2 ** 40 + me])],
+            average=False,
+        )
+        assert torch.allclose(gmix[0], torch.full((4,), 1.0)), gmix[0]
+        assert gmix[1].dtype == torch.int64, gmix[1].dtype
+        assert int(gmix[1]) == 2 ** 41 + 1, gmix[1]
+    finally:
+        del os.environ["HOROVOD_TPU_X64"]
+    try:
+        hvd.grouped_allreduce([torch.tensor([0x7FFFFFF0])], average=False)
+        raise AssertionError("grouped int64 mid-wire overflow not guarded")
+    except ValueError as e:
+        assert "overflow" in str(e), e
+
     # --- compression and Adasum ride the torch surface too.
     c = hvd.allreduce(torch.full((2048,), float(me + 1)), average=True,
                       name="t.int8", compression=hvd.Compression.int8)

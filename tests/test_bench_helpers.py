@@ -161,5 +161,27 @@ def test_bench_fusion_autotune_arm_cpu(bench, monkeypatch):
     assert out["fused_ms"] > 0 and out["unfused_ms"] > 0
     assert out["fused_arm_tensors_fused"] > 0
     assert out["autotune_rounds"] >= 1
-    assert out["autotune_threshold_bytes"] > 0
+    # The hill climber may legitimately pin threshold 0 on CPU (fusion is
+    # slower there) — assert the field exists, not a value.
+    assert isinstance(out["autotune_threshold_bytes"], int)
     assert isinstance(out["autotune_log"], list)
+
+
+def test_preserved_window_artifact_surfacing(bench, tmp_path, monkeypatch):
+    """A watcher-preserved on-chip artifact under docs/artifacts/ is
+    attached to a CPU-fallback line; CPU artifacts are ignored."""
+    import json as _json
+
+    art_dir = tmp_path / "docs" / "artifacts"
+    art_dir.mkdir(parents=True)
+    # Point the helper at a temp repo layout via __file__ monkeypatching.
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    assert bench._preserved_window_artifact() is None        # none yet
+    (art_dir / "BENCH_window_000.json").write_text(_json.dumps(
+        {"metric": "m", "value": 1.0, "extras": {"backend": "cpu"}}))
+    assert bench._preserved_window_artifact() is None        # cpu ignored
+    (art_dir / "BENCH_window_111.json").write_text(_json.dumps(
+        {"metric": "m", "value": 2000.0, "extras": {"backend": "tpu"}}))
+    got = bench._preserved_window_artifact()
+    assert got is not None and got["value"] == 2000.0
+    assert got["artifact_path"].endswith("BENCH_window_111.json")
