@@ -197,8 +197,17 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
             )
         except OSError:
             flags = platform.processor() or platform.platform()
+        # jaxlib in the key too: XLA injects target features beyond
+        # cpuinfo's (+prefer-no-scatter/gather and friends) that change
+        # across jaxlib builds — an AOT blob from another jaxlib on the
+        # SAME host trips the loader's feature check ("could lead to
+        # SIGILL") even though the cpuinfo fingerprint matches.
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
         host_key = hashlib.sha1(
-            (platform.machine() + ":" + flags).encode()).hexdigest()[:10]
+            (platform.machine() + ":" + jl + ":" + flags).encode()
+        ).hexdigest()[:10]
         jax.config.update(
             "jax_compilation_cache_dir", os.path.join(path, host_key))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
