@@ -73,6 +73,8 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     engine_stats,
     grouped_allreduce_eager,
     poll,
+    reducescatter,
+    reducescatter_async,
     sparse_allreduce,
     sparse_allreduce_async,
     synchronize,

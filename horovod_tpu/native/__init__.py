@@ -41,7 +41,7 @@ _SRC_DIR = _find_src_dir()
 
 # OpKind / DType wire values — must match native/src/types.h.
 KIND_ALLREDUCE, KIND_ALLGATHER, KIND_BROADCAST, KIND_SPARSE = 0, 1, 2, 3
-KIND_ALLTOALL = 4
+KIND_ALLTOALL, KIND_REDUCESCATTER = 4, 5
 
 _DTYPE_CODES = {
     "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,

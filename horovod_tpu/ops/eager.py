@@ -414,7 +414,7 @@ class EagerEngine:
             self.autotuner.observe(*tune_sample)
 
     _KIND_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
-                   "sparse": 3, "alltoall": 4}
+                   "sparse": 3, "alltoall": 4, "reducescatter": 5}
 
     def _controller_group(self, p: _PendingOp) -> int:
         """Encode fusability (reduce op, compression) into the controller's
@@ -799,6 +799,26 @@ class EagerEngine:
                     fn = self._shard_map(a2a, out_specs=P(self._axis))
                     self._dispatch_cache["a2a"] = fn
                 self._mark_single(p, fn(p.tensor))
+            elif p.kind == "reducescatter":
+                key = ("rs", p.op.name)
+                fn = self._dispatch_cache.get(key)
+                if fn is None:
+                    rs_op = p.op
+
+                    def rs(x):
+                        # Per-rank row [1, m, ...] → this rank's reduced
+                        # shard [1, m/n, ...] (Horovod ≥0.21
+                        # hvd.reducescatter semantics); the numerics live
+                        # in collective_ops.reducescatter — the
+                        # ncclReduceScatter leg of the reference's
+                        # hierarchical allreduce, operations.cc:1135-1158.
+                        return collective_ops.reducescatter(
+                            x[0], op=rs_op, axis_name=self._axis
+                        )[None]
+
+                    fn = self._shard_map(rs, out_specs=P(self._axis))
+                    self._dispatch_cache[key] = fn
+                self._mark_single(p, fn(p.tensor))
             elif p.kind == "sparse":
                 topk = p.topk
                 key = ("sp", topk.ratio, topk.k, p.op.name)
@@ -1048,6 +1068,39 @@ def alltoall_async(tensor, name: str | None = None) -> int:
 
 def alltoall(tensor, name: str | None = None):
     return synchronize(alltoall_async(tensor, name))
+
+
+def reducescatter_async(tensor, name: str | None = None, *,
+                        op: _ReduceOp = Average) -> int:
+    """Async reduce-scatter (the hvd.reducescatter API Horovod grew in
+    0.21): the rank-major input is reduced with ``op`` (Sum/Average —
+    default Average, matching Horovod's signature) and each rank keeps
+    shard r of the result along dim 0.  The result is RANK-MAJOR
+    ``[size, m/size, ...]`` — per-rank shards differ by design.  Dim 0 of
+    each rank's tensor must be divisible by ``size`` (equal shards, like
+    ``alltoall``)."""
+    eng = _engine()
+    t = _as_rank_major(tensor, "reducescatter")
+    n = basics.size()
+    if op not in (Sum, Average):
+        raise ValueError(f"reducescatter supports Sum/Average, not {op}")
+    if t.ndim < 2 or t.shape[1] % n != 0:
+        raise ValueError(
+            "reducescatter expects each rank's dim 0 to be divisible by "
+            f"size={n}; got per-rank shape {t.shape[1:]}"
+        )
+    name = name or _auto_name("reducescatter")
+    h = eng.handles.allocate(name)
+    eng.enqueue(
+        _PendingOp(kind="reducescatter", handle=h, tensor=t, name=name,
+                   op=op)
+    )
+    return h
+
+
+def reducescatter(tensor, name: str | None = None, *,
+                  op: _ReduceOp = Average):
+    return synchronize(reducescatter_async(tensor, name, op=op))
 
 
 def broadcast_async(tensor, root_rank: int, name: str | None = None, *,

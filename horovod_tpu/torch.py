@@ -230,42 +230,53 @@ def allreduce_async(tensor, average=True, name=None, *, op=None,
         op = Average if average else Sum
     if tensor.dtype in (torch.int64, torch.float64) and _x64_enabled():
         return _allreduce64_async(tensor, op, name, compression)
-    guard_h = None
-    if (tensor.dtype == torch.int64 and op in (Sum, Average)
-            and _basics.size() > 1):
-        # The wire is int32: inputs that individually fit can still
-        # overflow mid-reduce.  Guard with the sound per-rank bound
-        # |v| <= int32_max / world — but checked COLLECTIVELY (a Max
-        # allreduce of each rank's |v|max): the values differ per rank, so
-        # a local raise would diverge — one rank erroring while its peers
-        # sit in the posted collective until the stall watchdog fires.
-        # Every rank enqueues the probe, every rank sees the global
-        # maximum at synchronize, and all raise (or none do) together.
-        # Single-rank worlds skip the probe: nothing to desynchronize, no
-        # cross-rank sum, and _to_rank_major's range check already covers
-        # out-of-int32 inputs.  The escape hatch is HOROVOD_TPU_X64.
-        absmax = 0
-        if tensor.numel():
-            absmax = max(abs(int(tensor.max())), abs(int(tensor.min())))
-        probe = torch.tensor([min(absmax, 0x7FFFFFFF)], dtype=torch.int32)
-        guard_h = _eager.allreduce_async(
-            _to_rank_major(probe),
-            name=f"{name}.x64guard" if name else None,
-            op=Max,
-        )
-        if absmax > 0x7FFFFFFF:
-            # Values beyond the int32 wire entirely: a local raise would
-            # diverge, so ship a wire-valid clamped payload and let the
-            # guard — whose clamped probe always exceeds the bound —
-            # raise on every rank at synchronize; the result is discarded.
-            tensor = tensor.clamp(-0x80000000, 0x7FFFFFFF)
+    guard_h, tensor = _maybe_int64_guard(tensor, op, name)
     h = _eager.allreduce_async(
         _to_rank_major(tensor), name=name, op=op, compression=compression
     )
+    _attach_guard(h, guard_h, op)
+    return _note_wire_dtype(h, tensor)
+
+
+def _maybe_int64_guard(tensor, op, name):
+    """Collective int32-wire overflow guard for int64 Sum/Average.
+
+    Inputs that individually fit int32 can still overflow mid-reduce.
+    The sound per-rank bound |v| <= int32_max / world is checked
+    COLLECTIVELY (a Max allreduce of each rank's |v|max): the values
+    differ per rank, so a local raise would diverge — one rank erroring
+    while its peers sit in the posted collective until the stall watchdog
+    fires.  Every rank enqueues the probe, every rank sees the global
+    maximum at synchronize, and all raise (or none do) together.
+    Values beyond int32 entirely ride a wire-valid clamped payload whose
+    probe always exceeds the bound, so the result is discarded by the
+    same symmetric raise.  Single-rank worlds skip the probe: nothing to
+    desynchronize, and _to_rank_major's range check covers them.  The
+    escape hatch is HOROVOD_TPU_X64.
+
+    Returns ``(guard_handle_or_None, wire_tensor)``."""
+    torch = _torch()
+    if (tensor.dtype != torch.int64 or op not in (Sum, Average)
+            or _basics.size() <= 1):
+        return None, tensor
+    absmax = 0
+    if tensor.numel():
+        absmax = max(abs(int(tensor.max())), abs(int(tensor.min())))
+    probe = torch.tensor([min(absmax, 0x7FFFFFFF)], dtype=torch.int32)
+    guard_h = _eager.allreduce_async(
+        _to_rank_major(probe),
+        name=f"{name}.x64guard" if name else None,
+        op=Max,
+    )
+    if absmax > 0x7FFFFFFF:
+        tensor = tensor.clamp(-0x80000000, 0x7FFFFFFF)
+    return guard_h, tensor
+
+
+def _attach_guard(handle: int, guard_h: int | None, op) -> None:
     if guard_h is not None:
         bound = 0x7FFFFFFF // max(_basics.size(), 1)
-        _attach_post(h, x64_guard=(guard_h, bound, str(op)))
-    return _note_wire_dtype(h, tensor)
+        _attach_post(handle, x64_guard=(guard_h, bound, str(op)))
 
 
 def allreduce(tensor, average=True, name=None, *, op=None,
@@ -403,6 +414,41 @@ def alltoall_async(tensor, name=None) -> int:
 
 def alltoall(tensor, name=None):
     return synchronize(alltoall_async(tensor, name))
+
+
+def reducescatter_async(tensor, name=None, *, op=None) -> int:
+    """Async reduce-scatter on torch tensors (the hvd.reducescatter API
+    Horovod grew in 0.21): ranks' tensors are averaged (Horovod's default)
+    or summed, and this process keeps shard ``rank()`` along dim 0.
+    Dim 0 must be divisible by ``size()``.  Result extraction rides the
+    handle's rank-major post flag, like ``alltoall``.
+
+    64-bit dtypes follow ``allreduce``: the int64 Sum/Average overflow
+    guard raises symmetrically across ranks, and ``HOROVOD_TPU_X64``
+    routes through the exact bit-plane reduce with the shard sliced at
+    ``synchronize``."""
+    torch = _torch()
+    if op is None:
+        op = Average
+    if tensor.dtype in (torch.int64, torch.float64) and _x64_enabled():
+        n = _basics.size()
+        if tensor.dim() < 1 or tensor.shape[0] % n != 0:
+            raise ValueError(
+                "reducescatter expects dim 0 divisible by "
+                f"size={n}; got shape {tuple(tensor.shape)}"
+            )
+        h = _allreduce64_async(tensor, op, name, Compression.none)
+        _attach_post(h, x64_shard=True)
+        return h
+    guard_h, tensor = _maybe_int64_guard(tensor, op, name)
+    h = _eager.reducescatter_async(_to_rank_major(tensor), name=name, op=op)
+    _attach_post(h, rank_major=True)
+    _attach_guard(h, guard_h, op)
+    return _note_wire_dtype(h, tensor)
+
+
+def reducescatter(tensor, name=None, *, op=None):
+    return synchronize(reducescatter_async(tensor, name, op=op))
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
@@ -553,6 +599,13 @@ def synchronize(handle: int):
         else:                         # Product (validated at enqueue)
             red = vals.prod(axis=0)
         out = torch.from_numpy(np.ascontiguousarray(red).reshape(shape))
+        if post.get("x64_shard"):
+            # reducescatter rides the exact x64 reduce: keep this
+            # process's shard of the reduced tensor (dim-0 divisibility
+            # validated at enqueue).
+            n = _basics.size()
+            m = out.shape[0] // n
+            out = out[_basics.rank() * m:(_basics.rank() + 1) * m].clone()
     x64b = post.get("x64_bcast")
     if x64b is not None:
         want_dtype, shape = x64b

@@ -69,7 +69,7 @@ int hvdtpu_controller_submit(void* ctrl, unsigned char kind,
                              unsigned char dtype, const char* name,
                              const long long* shape, int ndim, int root_rank,
                              long long group) {
-  if (!ctrl || !name || kind > 4 || dtype > 12) return -1;
+  if (!ctrl || !name || kind > 5 || dtype > 12) return -1;
   Request r;
   r.kind = static_cast<OpKind>(kind);
   r.dtype = static_cast<DType>(dtype);

@@ -34,6 +34,8 @@ const char* KindName(OpKind k) {
       return "sparse_allreduce";
     case OpKind::kAlltoall:
       return "alltoall";
+    case OpKind::kReduceScatter:
+      return "reducescatter";
   }
   return "?";
 }
@@ -96,7 +98,8 @@ void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
     switch (r.kind) {
       case OpKind::kAllreduce:
       case OpKind::kSparse:
-      case OpKind::kAlltoall:  // equal splits: identical shapes everywhere
+      case OpKind::kAlltoall:       // equal splits: identical shapes everywhere
+      case OpKind::kReduceScatter:  // equal shards: identical shapes everywhere
         if (r.shape != f.shape)
           e.error = std::string("Mismatched ") + KindName(r.kind) +
                     " tensor shapes for " + r.name + ": " +

@@ -24,6 +24,7 @@ enum class OpKind : uint8_t {
   kBroadcast = 2,
   kSparse = 3,
   kAlltoall = 4,
+  kReduceScatter = 5,
 };
 
 // Dtype vocabulary (JAX-facing; sizes used only for fusion accounting).

@@ -95,6 +95,26 @@ def main() -> None:
                             name="t.a2a.async")
     assert torch.allclose(hvd.synchronize(ah), want_a2a)
 
+    # --- reducescatter (Horovod ≥0.21 API): tensors reduce across ranks
+    # and this process keeps shard rank() along dim 0.
+    rs = hvd.reducescatter(torch.arange(4, dtype=torch.float32) + me,
+                           name="t.rs", op=hvd.Sum)
+    want_rs = (torch.tensor([1.0, 3.0]) if me == 0
+               else torch.tensor([5.0, 7.0]))
+    assert torch.allclose(rs, want_rs), rs
+    # Default op is Average (Horovod's signature).
+    rsa = hvd.synchronize(hvd.reducescatter_async(
+        torch.full((2,), float(me)), name="t.rs.avg"))
+    assert torch.allclose(rsa, torch.full((1,), 0.5)), rsa
+    # int64 mid-wire Sum overflow: same symmetric collective guard as
+    # allreduce (values fit int32 individually; the sum does not).
+    try:
+        hvd.reducescatter(torch.tensor([0x7FFFFFF0, 1]), name="t.rs.guard",
+                          op=hvd.Sum)
+        raise AssertionError("reducescatter int64 overflow not guarded")
+    except ValueError as e:
+        assert "overflow" in str(e), e
+
     # --- broadcast.
     b = hvd.broadcast(torch.full((2,), float(me + 5)), 1, name="t.bcast")
     assert torch.allclose(b, torch.full((2,), 6.0)), b
@@ -322,6 +342,13 @@ def main() -> None:
         ip = torch.tensor([2 ** 33])
         hh = hvd.allreduce_async_(ip, average=False, name="t.x64.ip")
         assert hvd.synchronize(hh) is ip and int(ip) == 2 ** 34, ip
+        # exact reducescatter: reduce in 64-bit, keep this rank's shard
+        rs64 = hvd.reducescatter(
+            torch.tensor([2 ** 40 + me, 2 ** 41 + me]), name="t.x64.rs",
+            op=hvd.Sum,
+        )
+        assert rs64.dtype == torch.int64 and rs64.shape == (1,), rs64
+        assert int(rs64) == (2 ** 41 + 1 if me == 0 else 2 ** 42 + 1), rs64
     finally:
         del os.environ["HOROVOD_TPU_X64"]
 

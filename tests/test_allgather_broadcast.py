@@ -114,3 +114,32 @@ def test_eager_alltoall_validates_divisibility():
     bad = hvd.per_rank(lambda r: jnp.zeros((n + 1,), jnp.float32))
     with pytest.raises(ValueError, match="divisible"):
         hvd.alltoall_async(bad)
+
+
+def test_eager_reducescatter():
+    """hvd.reducescatter (Horovod >=0.21 API): ranks' tensors reduce and
+    rank r keeps shard r along dim 0; Sum and Average; result rank-major."""
+    n = hvd.size()
+    # rank r's tensor: n shards of 2, shard s = r + s*10
+    x = hvd.per_rank(
+        lambda r: jnp.repeat(jnp.arange(n, dtype=jnp.float32) * 10 + r, 2)
+    )
+    out = np.asarray(hvd.reducescatter(x, name="rs.t", op=hvd.Sum))
+    assert out.shape == (n, 2)
+    ranksum = n * (n - 1) / 2.0
+    want = np.repeat(np.arange(n, dtype=np.float32) * 10 * n + ranksum,
+                     2).reshape(n, 2)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    # Default op is Average — Horovod's reducescatter signature.
+    avg = np.asarray(hvd.reducescatter(x, name="rs.avg"))
+    np.testing.assert_allclose(avg, want / n, rtol=1e-6)
+
+
+def test_eager_reducescatter_validates():
+    n = hvd.size()
+    bad = hvd.per_rank(lambda r: jnp.zeros((n + 1,), jnp.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.reducescatter_async(bad)
+    ok = hvd.per_rank(lambda r: jnp.zeros((n,), jnp.float32))
+    with pytest.raises(ValueError, match="Sum/Average"):
+        hvd.reducescatter_async(ok, op=hvd.Min)

@@ -36,7 +36,9 @@ def test_engine_random_interleaving(seed):
     pending = []   # (handle, oracle ndarray, kind)
 
     for i in range(14):
-        kind = rng.choice(["allreduce", "allgather", "broadcast"])
+        kind = rng.choice(
+            ["allreduce", "allgather", "broadcast", "reducescatter"]
+        )
         shape = SHAPES[int(rng.integers(len(SHAPES)))]
         dtype = DTYPES[int(rng.integers(len(DTYPES)))]
         data = _rank_major(n, shape, dtype, rng)
@@ -48,6 +50,12 @@ def test_engine_random_interleaving(seed):
         elif kind == "allgather":
             h = hvd.allgather_async(jnp.asarray(data), name=name)
             want = data.reshape(n * shape[0], *shape[1:])
+        elif kind == "reducescatter":
+            shape = (2 * n,)           # dim 0 must divide by the mesh
+            data = _rank_major(n, shape, np.float32, rng)
+            h = hvd.reducescatter_async(jnp.asarray(data), name=name,
+                                        op=hvd.Sum)
+            want = data.sum(axis=0).reshape(n, 2)   # rank-major shards
         else:
             root = int(rng.integers(n))
             h = hvd.broadcast_async(jnp.asarray(data), root, name=name)
