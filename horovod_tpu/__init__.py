@@ -72,6 +72,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     broadcast_async,
     engine_stats,
     grouped_allreduce_eager,
+    join,
     poll,
     reducescatter,
     reducescatter_async,

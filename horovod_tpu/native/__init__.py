@@ -41,13 +41,18 @@ _SRC_DIR = _find_src_dir()
 
 # OpKind / DType wire values — must match native/src/types.h.
 KIND_ALLREDUCE, KIND_ALLGATHER, KIND_BROADCAST, KIND_SPARSE = 0, 1, 2, 3
-KIND_ALLTOALL, KIND_REDUCESCATTER = 4, 5
+KIND_ALLTOALL, KIND_REDUCESCATTER, KIND_JOIN = 4, 5, 6
+
+# Dispatch-program codes (types.h OpCode): what a JOINED rank must launch
+# to participate in a batch it never submitted.
+OP_PLAIN_SUM, OP_PLAIN_AVERAGE, OP_OTHER = 0, 1, 2
 
 _DTYPE_CODES = {
     "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
     "int64": 5, "float16": 6, "bfloat16": 7, "float32": 8, "float64": 9,
     "bool": 10, "uint32": 11, "uint64": 12,
 }
+DTYPE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
 
 _build_lock = threading.Lock()
 _lib = None
@@ -119,7 +124,7 @@ def load_library() -> ctypes.CDLL:
         lib.hvdtpu_controller_submit.argtypes = [
             ctypes.c_void_p, ctypes.c_ubyte, ctypes.c_ubyte, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
-            ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_ubyte,
         ]
         lib.hvdtpu_controller_request_shutdown.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_controller_tick.restype = ctypes.c_int
@@ -161,6 +166,11 @@ class Batch:
     kind: int
     error: str
     names: list[str] = field(default_factory=list)
+    # Wire dtype code + dispatch-program code + per-name shapes: a JOINED
+    # rank reconstructs the exact collective for tensors it never saw.
+    dtype: int = 8  # kF32
+    op_code: int = OP_OTHER
+    shapes: list[tuple[int, ...]] = field(default_factory=list)
 
 
 @dataclass
@@ -171,6 +181,8 @@ class BatchList:
     # rank observes a move in the same tick (control-plane autotune).
     tuned_threshold_bytes: int | None = None
     tuned_cycle_ms: float | None = None
+    # >= 0 once every rank has joined (hvd.join): the last rank to join.
+    last_joined: int = -1
 
 
 def _parse_batch_list(data: bytes) -> BatchList:
@@ -202,19 +214,33 @@ def _parse_batch_list(data: bytes) -> BatchList:
         off += n
         return v
 
+    def i32():
+        nonlocal off
+        (v,) = struct.unpack_from("<i", data, off)
+        off += 4
+        return v
+
     shutdown = u8() != 0
     thr = i64()
     cyc_us = i64()
+    last_joined = i32()
     batches = []
     for _ in range(u32()):
         kind = u8()
+        dtype = u8()
+        op_code = u8()
         error = s()
         names = [s() for _ in range(u32())]
-        batches.append(Batch(kind, error, names))
+        shapes = [
+            tuple(i64() for _ in range(u32())) for _ in range(len(names))
+        ]
+        batches.append(Batch(kind, error, names, dtype=dtype,
+                             op_code=op_code, shapes=shapes))
     return BatchList(
         shutdown, batches,
         tuned_threshold_bytes=thr if thr >= 0 else None,
         tuned_cycle_ms=cyc_us / 1000.0 if cyc_us >= 0 else None,
+        last_joined=last_joined,
     )
 
 
@@ -238,17 +264,26 @@ class NativeController:
 
     def submit(self, kind: int, dtype: str, name: str,
                shape: tuple[int, ...], root_rank: int = 0,
-               group: int = -1) -> None:
+               group: int = -1, op_code: int = OP_OTHER) -> None:
         code = _DTYPE_CODES.get(str(dtype))
         if code is None:
             raise ValueError(f"dtype {dtype} not supported by the native wire")
         arr = (ctypes.c_longlong * len(shape))(*shape)
         rc = self._lib.hvdtpu_controller_submit(
             self._ptr, kind, code, name.encode(), arr, len(shape),
-            root_rank, group,
+            root_rank, group, op_code,
         )
         if rc != 0:
             raise RuntimeError(f"native submit rejected request {name!r}")
+
+    def submit_join(self) -> None:
+        """Flip this rank's joined bit (hvd.join): its missing submissions
+        stop blocking readiness from the next tick."""
+        rc = self._lib.hvdtpu_controller_submit(
+            self._ptr, KIND_JOIN, 4, b"__join__", None, 0, 0, -1, OP_OTHER,
+        )
+        if rc != 0:
+            raise RuntimeError("native submit rejected the join request")
 
     def tick(self) -> BatchList:
         if not self._ptr:

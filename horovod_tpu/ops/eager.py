@@ -152,6 +152,11 @@ class EagerEngine:
             # (reference timeline.cc:98-132); drained after every tick.
             self.controller.enable_tick_trace()
         self._submitted: dict[str, _PendingOp] = {}
+        # hvd.join state: while active, batches with names this rank never
+        # submitted are filled with zero phantoms (_join_fill); the
+        # all-joined response's last rank lands in _join_result.
+        self._join_active = False
+        self._join_result: int | None = None
         self.autotuner = None
         if cfg.autotune:
             if self.controller is not None:
@@ -456,6 +461,51 @@ class EagerEngine:
 
         return int.from_bytes(hashlib.sha1(token).digest()[:7], "big")
 
+    @staticmethod
+    def _op_code(p: _PendingOp) -> int:
+        """Dispatch-program code for join support (types.h OpCode): a
+        joined rank can fabricate identity inputs only for the plain
+        Sum/Average allreduce program — everything else is kOpOther and
+        the controller errors it if it can only complete via joins."""
+        from horovod_tpu import native
+
+        if (p.kind == "allreduce" and p.process_set is None
+                and p.compression is Compression.none):
+            if p.op is Sum:
+                return native.OP_PLAIN_SUM
+            if p.op is Average:
+                return native.OP_PLAIN_AVERAGE
+        return native.OP_OTHER
+
+    def _join_fill(self, b, ops: list[_PendingOp]) -> list[_PendingOp] | None:
+        """Fill a batch this JOINED rank only partially (or never)
+        submitted: phantom ops with identity (zero) inputs stand in for
+        the missing names, so this rank launches the SAME compiled
+        collective as its active peers — the XLA collective is global
+        across processes, and a joined rank that skipped the launch would
+        hang the gang (the join op of Horovod ≥0.21 feeds zero tensors the
+        same way).  Returns None when the batch is not join-eligible
+        (then the caller's silent-skip fallback applies)."""
+        from horovod_tpu import native
+
+        if (not self._join_active or b.kind != native.KIND_ALLREDUCE
+                or b.op_code not in (native.OP_PLAIN_SUM,
+                                     native.OP_PLAIN_AVERAGE)):
+            return None
+        import numpy as _np
+
+        dtype = _np.dtype(native.DTYPE_NAMES.get(b.dtype, "float32"))
+        op = (Average if b.op_code == native.OP_PLAIN_AVERAGE else Sum)
+        n = self.mesh.devices.size
+        by_name = {p.name: p for p in ops}
+        return [
+            by_name.get(name) or _PendingOp(
+                kind="allreduce", handle=-1,
+                tensor=jnp.zeros((n, *shape), dtype=dtype), name=name, op=op,
+            )
+            for name, shape in zip(b.names, b.shapes)
+        ]
+
     def _flush_via_controller(self, batch: list[_PendingOp]):
         """Submit new requests, run one negotiation tick, dispatch the
         globally-agreed batches (names → this process's pending ops).
@@ -481,6 +531,7 @@ class EagerEngine:
                     tuple(p.tensor.shape[1:]),
                     root_rank=p.root_rank,
                     group=self._controller_group(p),
+                    op_code=self._op_code(p),
                 )
             except Exception as e:
                 # Per-op containment, like the non-controller dispatch path:
@@ -513,11 +564,24 @@ class EagerEngine:
                 self.config.fusion_threshold_bytes = bl.tuned_threshold_bytes
             if bl.tuned_cycle_ms is not None:
                 self.config.cycle_time_ms = bl.tuned_cycle_ms
+        if bl.last_joined >= 0:
+            with self._lock:
+                self._join_result = bl.last_joined
         ar_bytes, sample_out = 0, None
         for b in bl.batches:
             ops = [
                 self._submitted.pop(n) for n in b.names if n in self._submitted
             ]
+            if len(ops) != len(b.names) and not b.error:
+                full = self._join_fill(b, ops)
+                if full is not None:
+                    for p in ops:
+                        self._end_negotiate(p)
+                    out, nb = self._dispatch_allreduce_group(full)
+                    if out is not None and ops:
+                        ar_bytes += nb
+                        sample_out = out
+                    continue
             if not ops:
                 continue
             for p in ops:
@@ -556,6 +620,50 @@ class EagerEngine:
             self.timeline.end(
                 p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
             )
+
+    def join(self) -> int:
+        """Declare this rank out of data (the ``hvd.join()`` API Horovod
+        grew in 0.21 for uneven datasets): block until EVERY rank has
+        joined, meanwhile participating in the gang's remaining plain
+        Sum/Average allreduces with identity (zero) inputs so active ranks
+        never stall.  Returns the last rank to join — a root guaranteed to
+        have processed all its data.
+
+        Needs the native controller (multi-process gangs).  In a
+        single-controller world every rank is driven by this process, so
+        all "join" simultaneously: returns ``size - 1`` immediately.
+        """
+        if self.controller is None:
+            if jax.process_count() > 1:
+                raise RuntimeError(
+                    "hvd.join() needs the native controller "
+                    "(HOROVOD_TPU_NATIVE_CONTROLLER=on + a controller "
+                    "transport); Python-degraded coordination cannot "
+                    "negotiate joined ranks"
+                )
+            self.flush()
+            return self.mesh.devices.size - 1
+        self.flush()                     # drain this rank's own queue first
+        with self._lock:
+            self._join_result = None
+            self._join_active = True
+        try:
+            self.controller.submit_join()
+            while True:
+                self.flush()
+                with self._lock:
+                    r = self._join_result
+                if r is not None:
+                    return r
+                if self._shutdown.is_set():
+                    raise RuntimeError(
+                        "engine shut down while waiting in hvd.join()"
+                    )
+                time.sleep(max(self.config.cycle_time_ms, 0.5) / 1000.0)
+        finally:
+            with self._lock:
+                self._join_active = False
+                self._join_result = None
 
     def _cycle_loop(self) -> None:
         """Background tick every ``HOROVOD_CYCLE_TIME`` ms
@@ -700,6 +808,8 @@ class EagerEngine:
             if self._serialize_dispatch:
                 jax.block_until_ready(outs)
             for p, out in zip(group, outs):
+                if p.handle < 0:
+                    continue  # joined-rank phantom: output discarded
                 shape = p.tensor.shape if ps is not None else p.tensor.shape[1:]
                 self.handles.mark_dispatched(p.handle, out.reshape(shape))
             self.stats["batches_dispatched"] += 1
@@ -709,7 +819,8 @@ class EagerEngine:
             return outs[-1], nbytes
         except Exception as e:
             for p in group:
-                self._mark_error(p.handle, e)
+                if p.handle >= 0:
+                    self._mark_error(p.handle, e)
             return None, nbytes
         finally:
             if tl:
@@ -1101,6 +1212,14 @@ def reducescatter_async(tensor, name: str | None = None, *,
 def reducescatter(tensor, name: str | None = None, *,
                   op: _ReduceOp = Average):
     return synchronize(reducescatter_async(tensor, name, op=op))
+
+
+def join() -> int:
+    """``hvd.join()`` (Horovod ≥0.21): this rank is out of data — block
+    until every rank joins, contributing zeros to the gang's remaining
+    plain Sum/Average allreduces meanwhile.  Returns the last rank to
+    join.  See ``EagerEngine.join`` for the mechanics."""
+    return _engine().join()
 
 
 def broadcast_async(tensor, root_rank: int, name: str | None = None, *,

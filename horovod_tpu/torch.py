@@ -451,6 +451,16 @@ def reducescatter(tensor, name=None, *, op=None):
     return synchronize(reducescatter_async(tensor, name, op=op))
 
 
+def join(device: int = -1) -> int:
+    """``hvd.join()`` (Horovod ≥0.21 torch API): this process is out of
+    data — block until every rank joins, contributing zeros to the
+    remaining plain Sum/Average allreduces meanwhile; returns the last
+    rank to join.  ``device`` is accepted for signature parity and
+    ignored (the TPU runtime owns placement)."""
+    del device
+    return _eager.join()
+
+
 def broadcast_async(tensor, root_rank, name=None) -> int:
     torch = _torch()
     if tensor.dtype in (torch.int64, torch.float64) and _x64_enabled():

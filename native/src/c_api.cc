@@ -68,11 +68,12 @@ void hvdtpu_controller_destroy(void* ctrl) {
 int hvdtpu_controller_submit(void* ctrl, unsigned char kind,
                              unsigned char dtype, const char* name,
                              const long long* shape, int ndim, int root_rank,
-                             long long group) {
-  if (!ctrl || !name || kind > 5 || dtype > 12) return -1;
+                             long long group, unsigned char op_code) {
+  if (!ctrl || !name || kind > 6 || dtype > 12 || op_code > 2) return -1;
   Request r;
   r.kind = static_cast<OpKind>(kind);
   r.dtype = static_cast<DType>(dtype);
+  r.op_code = op_code;
   r.name = name;
   r.root_rank = root_rank;
   r.group = group;

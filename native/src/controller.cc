@@ -36,6 +36,8 @@ const char* KindName(OpKind k) {
       return "alltoall";
     case OpKind::kReduceScatter:
       return "reducescatter";
+    case OpKind::kJoin:
+      return "join";
   }
   return "?";
 }
@@ -62,7 +64,42 @@ void Controller::RequestShutdown() {
   shutdown_requested_ = true;
 }
 
+bool Controller::Complete(const TableEntry& e) const {
+  for (int r = 0; r < size_; ++r) {
+    if (!e.seen[r] && (joined_.empty() || !joined_[r])) return false;
+  }
+  return true;
+}
+
+void Controller::MaybePush(const std::string& name, TableEntry& e,
+                           std::vector<std::string>* ready) {
+  if (e.pushed || !Complete(e)) return;
+  if (e.error.empty() && e.count < size_) {
+    // Completed via joined ranks: those ranks fabricate identity
+    // contributions, which is only sound for the plain Sum/Average
+    // allreduce program (zeros are the identity and every rank can
+    // reconstruct the exact compiled collective from the batch alone).
+    if (e.first.kind != OpKind::kAllreduce ||
+        e.first.op_code > kOpPlainAverage) {
+      e.error = std::string(KindName(e.first.kind)) + " for " + name +
+                " cannot complete while ranks are joined (hvd.join " +
+                "supports plain Sum/Average allreduce only)";
+    }
+  }
+  e.pushed = true;
+  ready->push_back(name);
+}
+
 void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
+  if (r.kind == OpKind::kJoin) {
+    if (joined_.empty()) joined_.assign(size_, false);
+    if (r.rank >= 0 && r.rank < size_ && !joined_[r.rank]) {
+      joined_[r.rank] = true;
+      ++joined_count_;
+      last_joined_ = r.rank;
+    }
+    return;
+  }
   auto it = table_.find(r.name);
   if (it == table_.end()) {
     TableEntry e;
@@ -121,9 +158,11 @@ void Controller::Ingest(const Request& r, std::vector<std::string>* ready) {
         else if (r.shape != f.shape)
           e.error = "Mismatched broadcast tensor shapes for " + r.name;
         break;
+      case OpKind::kJoin:
+        break;  // handled (early-return) above; silences -Wswitch
     }
   }
-  if (e.count == size_) ready->push_back(r.name);
+  MaybePush(r.name, e, ready);
 }
 
 BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
@@ -146,8 +185,11 @@ BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
       flush();
       Batch b;
       b.kind = e.first.kind;
+      b.dtype = e.first.dtype;
+      b.op_code = e.first.op_code;
       b.error = e.error;
       b.names.push_back(name);
+      b.shapes.push_back(e.first.shape);
       bl.batches.push_back(std::move(b));
     } else {
       // Merge consecutive ready allreduces of one dtype and fusion group up
@@ -158,9 +200,12 @@ BatchList Controller::BuildBatches(const std::vector<std::string>& ready) {
                         cur_group == e.first.group;
       if (!same || cur_bytes + bytes > EffectiveThreshold()) flush();
       cur.kind = OpKind::kAllreduce;
+      cur.dtype = e.first.dtype;
+      cur.op_code = e.first.op_code;
       cur_dtype = e.first.dtype;
       cur_group = e.first.group;
       cur.names.push_back(name);
+      cur.shapes.push_back(e.first.shape);
       cur_bytes += bytes;
     }
     table_.erase(it);
@@ -189,16 +234,32 @@ TickStatus Controller::Tick(BatchList* out) {
     bool shutdown_seen = false;
     std::vector<std::string> ready;
     std::lock_guard<std::mutex> lk(table_mu_);
+    const int joined_before = joined_count_;
     for (const std::string& payload : gathered) {
       wire::Reader rd(payload);
       RequestList rl = wire::ParseRequestList(rd);
       if (rl.shutdown) shutdown_seen = true;
       for (const Request& r : rl.requests) Ingest(r, &ready);
     }
+    if (joined_count_ > joined_before) {
+      // A join landed this tick: entries whose only missing contributors
+      // just joined become ready NOW — rescan (std::map order, so the
+      // emitted order is deterministic on the one rank that builds).
+      for (auto& kv : table_) MaybePush(kv.first, kv.second, &ready);
+    }
     BatchList built = BuildBatches(ready);
     built.shutdown = shutdown_seen;
     built.tuned_threshold_bytes = tuned_threshold_bytes_;
     built.tuned_cycle_ms = tuned_cycle_ms_;
+    if (joined_count_ == size_) {
+      // Everyone joined: report the last joiner and reset for the next
+      // join epoch (reference-era Horovod returns it so callers can pick
+      // a root that is guaranteed to have processed all its data).
+      built.last_joined = last_joined_;
+      joined_.assign(size_, false);
+      joined_count_ = 0;
+      last_joined_ = -1;
+    }
     response_bytes = wire::SerializeBatchList(built);
   }
   std::string received;
@@ -244,7 +305,7 @@ std::string Controller::StallReport() {
     any = true;
     os << kv.first << " (missing ranks:";
     for (int r = 0; r < size_; ++r)
-      if (!e.seen[r]) os << " " << r;
+      if (!e.seen[r] && (joined_.empty() || !joined_[r])) os << " " << r;
     os << ")";
   }
   return os.str();

@@ -85,10 +85,22 @@ class Controller {
     int count = 0;
     std::string error;        // sticky validation error
     double first_seen_s = 0;  // monotonic arrival time of first request
+    bool pushed = false;      // already emitted to a ready list this tick
   };
 
   void Ingest(const Request& r, std::vector<std::string>* ready);
   BatchList BuildBatches(const std::vector<std::string>& ready);
+
+  // hvd.join support: an entry is complete when every rank has either
+  // submitted it or joined (a joined rank's contribution is fabricated as
+  // the identity by its engine).  Called under table_mu_.
+  bool Complete(const TableEntry& e) const;
+  // Emit `name` once if its entry just became complete; entries that
+  // complete only via joined ranks are restricted to plain Sum/Average
+  // allreduce — anything else needs a submission from every rank to agree
+  // on the dispatch program.
+  void MaybePush(const std::string& name, TableEntry& e,
+                 std::vector<std::string>* ready);
 
   // Effective fusion threshold: the tuned value when set, else the
   // construction-time one.  Called under table_mu_.
@@ -114,6 +126,12 @@ class Controller {
   // StallReport reads it from the stall-watchdog thread.
   std::mutex table_mu_;
   std::map<std::string, TableEntry> table_;
+  // hvd.join state (rank-0 only, guarded by table_mu_): joined ranks stop
+  // blocking readiness; once all `size_` ranks joined, the response
+  // carries the last joiner and the set resets for the next epoch.
+  std::vector<bool> joined_;
+  int joined_count_ = 0;
+  int32_t last_joined_ = -1;
   bool tick_trace_enabled_ = false;           // guarded by table_mu_
   std::vector<std::pair<std::string, int>> tick_events_;  // guarded by table_mu_
 };

@@ -168,6 +168,73 @@ void TestTcp() {
   std::printf("tcp transport ok\n");
 }
 
+// hvd.join: a joined rank stops blocking readiness; the batch carries
+// dtype/op_code/shapes so the joined rank can fabricate identity inputs;
+// non-plain ops cannot complete via joins; once ALL ranks join, the
+// response reports the last joiner and the epoch resets.
+void TestJoin() {
+  const int kSize = 2;
+  std::vector<Batch> first(kSize);
+  std::vector<Batch> gathered(kSize);
+  std::vector<int> last(kSize, -1);
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < kSize; ++rank) {
+    threads.emplace_back([rank, &first, &gathered, &last] {
+      auto c = MakeLocal("join", rank, kSize, 1 << 20);
+      if (rank == 0) {
+        Request j;
+        j.kind = OpKind::kJoin;
+        c->Submit(j);
+      } else {
+        Request r = AR("x", {8});
+        r.op_code = kOpPlainSum;
+        c->Submit(r);
+      }
+      BatchList bl;
+      bool have = false;
+      while (!have) {
+        assert(c->Tick(&bl) == TickStatus::kLive);
+        for (auto& b : bl.batches) {
+          first[rank] = b;
+          have = true;
+        }
+      }
+      // Non-plain op while rank 0 is joined: must error, not hang.
+      if (rank == 1) c->Submit(AR("g", {3}));  // op_code defaults kOpOther
+      have = false;
+      while (!have) {
+        assert(c->Tick(&bl) == TickStatus::kLive);
+        for (auto& b : bl.batches) {
+          gathered[rank] = b;
+          have = true;
+        }
+      }
+      // Rank 1 joins too: everyone ticks until the all-joined response.
+      if (rank == 1) {
+        Request j;
+        j.kind = OpKind::kJoin;
+        c->Submit(j);
+      }
+      while (last[rank] < 0) {
+        assert(c->Tick(&bl) == TickStatus::kLive);
+        if (bl.last_joined >= 0) last[rank] = bl.last_joined;
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  for (int r = 0; r < kSize; ++r) {
+    assert(first[r].error.empty());
+    assert(first[r].names == std::vector<std::string>({"x"}));
+    assert(first[r].shapes == std::vector<std::vector<int64_t>>({{8}}));
+    assert(first[r].op_code == kOpPlainSum);
+    assert(gathered[r].names == std::vector<std::string>({"g"}));
+    assert(!gathered[r].error.empty());
+    assert(gathered[r].error.find("join") != std::string::npos);
+    assert(last[r] == 1);
+  }
+  std::printf("join ok\n");
+}
+
 }  // namespace
 
 int main() {
@@ -176,6 +243,7 @@ int main() {
   TestShapeMismatch();
   TestShutdown();
   TestTcp();
+  TestJoin();
   std::printf("all native self-tests passed\n");
   return 0;
 }

@@ -25,6 +25,21 @@ enum class OpKind : uint8_t {
   kSparse = 3,
   kAlltoall = 4,
   kReduceScatter = 5,
+  // Control-plane pseudo-op: "this rank has no more work" (the hvd.join()
+  // API Horovod grew in 0.21 for uneven data).  Never enters the message
+  // table; flips the rank's joined bit so its missing submissions stop
+  // blocking readiness.
+  kJoin = 6,
+};
+
+// Dispatch-program codes for join support: a joined rank must launch the
+// SAME compiled collective as its peers, so batches carry which program
+// that is.  Anything beyond plain Sum/Average (compression, process sets,
+// Adasum) is kOther and cannot complete via joined ranks.
+enum OpCode : uint8_t {
+  kOpPlainSum = 0,
+  kOpPlainAverage = 1,
+  kOpOther = 2,
 };
 
 // Dtype vocabulary (JAX-facing; sizes used only for fusion accounting).
@@ -71,6 +86,7 @@ inline int DTypeSize(DType d) {
 struct Request {
   OpKind kind = OpKind::kAllreduce;
   DType dtype = DType::kF32;
+  uint8_t op_code = kOpOther;  // dispatch program (OpCode); join support
   int32_t rank = 0;
   int32_t root_rank = 0;
   int64_t group = -1;  // caller-delimited fusion group; -1 = none
@@ -95,8 +111,13 @@ struct RequestList {
 // op, not the job — horovod/common/operations.cc:516-519).
 struct Batch {
   OpKind kind = OpKind::kAllreduce;
+  DType dtype = DType::kF32;
+  uint8_t op_code = kOpOther;  // OpCode of the batch's dispatch program
   std::string error;
   std::vector<std::string> names;
+  // Per-name per-rank shapes (parallel to `names`): lets a JOINED rank
+  // fabricate identity contributions for tensors it never submitted.
+  std::vector<std::vector<int64_t>> shapes;
 };
 
 struct BatchList {
@@ -107,6 +128,9 @@ struct BatchList {
   // Negative = "no value"; receivers keep their current setting.
   int64_t tuned_threshold_bytes = -1;
   double tuned_cycle_ms = -1.0;
+  // >= 0 once EVERY rank has joined (hvd.join): the last rank to join.
+  // One-shot — the joined set resets so the next epoch starts clean.
+  int32_t last_joined = -1;
 };
 
 }  // namespace hvdtpu

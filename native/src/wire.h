@@ -108,6 +108,7 @@ inline std::string SerializeRequestList(const RequestList& rl) {
   for (const Request& r : rl.requests) {
     w.U8(static_cast<uint8_t>(r.kind));
     w.U8(static_cast<uint8_t>(r.dtype));
+    w.U8(r.op_code);
     w.I32(r.rank);
     w.I32(r.root_rank);
     w.I64(r.group);
@@ -121,13 +122,15 @@ inline std::string SerializeRequestList(const RequestList& rl) {
 inline RequestList ParseRequestList(Reader& rd) {
   RequestList rl;
   rl.shutdown = rd.U8() != 0;
-  // Min fixed bytes per request: kind+dtype+rank+root+group+2 counts = 26.
-  uint32_t n = rd.Count(26);
+  // Min fixed bytes per request: kind+dtype+op_code+rank+root+group+2
+  // counts = 27.
+  uint32_t n = rd.Count(27);
   rl.requests.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     Request r;
     r.kind = static_cast<OpKind>(rd.U8());
     r.dtype = static_cast<DType>(rd.U8());
+    r.op_code = rd.U8();
     r.rank = rd.I32();
     r.root_rank = rd.I32();
     r.group = rd.I64();
@@ -148,12 +151,24 @@ inline std::string SerializeBatchList(const BatchList& bl) {
   // llround, not a truncating cast: N/1000.0*1000.0 can land just below N
   // (e.g. 0.057 ms -> 56.999... µs) and truncation would change the value.
   w.I64(bl.tuned_cycle_ms < 0 ? -1 : llround(bl.tuned_cycle_ms * 1000.0));
+  w.I32(bl.last_joined);
   w.U32(static_cast<uint32_t>(bl.batches.size()));
   for (const Batch& b : bl.batches) {
     w.U8(static_cast<uint8_t>(b.kind));
+    w.U8(static_cast<uint8_t>(b.dtype));
+    w.U8(b.op_code);
     w.Str(b.error);
     w.U32(static_cast<uint32_t>(b.names.size()));
     for (const std::string& nm : b.names) w.Str(nm);
+    // shapes[] is parallel to names[]: one (ndim, dims...) per name.
+    // Total even for malformed batches — a missing entry serializes as
+    // scalar () rather than desynchronizing the stream.
+    for (size_t j = 0; j < b.names.size(); ++j) {
+      const std::vector<int64_t>* s = j < b.shapes.size() ? &b.shapes[j] : nullptr;
+      w.U32(s ? static_cast<uint32_t>(s->size()) : 0);
+      if (s)
+        for (int64_t d : *s) w.I64(d);
+    }
   }
   return w.Take();
 }
@@ -164,16 +179,28 @@ inline BatchList ParseBatchList(Reader& rd) {
   bl.tuned_threshold_bytes = rd.I64();
   const int64_t cyc_us = rd.I64();
   bl.tuned_cycle_ms = cyc_us < 0 ? -1.0 : cyc_us / 1000.0;
-  // Min fixed bytes per batch: kind + error len + name count = 9.
-  uint32_t n = rd.Count(9);
+  bl.last_joined = rd.I32();
+  // Min fixed bytes per batch: kind + dtype + op_code + error len +
+  // name count = 11.
+  uint32_t n = rd.Count(11);
   bl.batches.reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     Batch b;
     b.kind = static_cast<OpKind>(rd.U8());
+    b.dtype = static_cast<DType>(rd.U8());
+    b.op_code = rd.U8();
     b.error = rd.Str();
     uint32_t m = rd.Count(4);
     b.names.reserve(m);
     for (uint32_t j = 0; j < m; ++j) b.names.push_back(rd.Str());
+    b.shapes.reserve(m);
+    for (uint32_t j = 0; j < m; ++j) {
+      uint32_t nd = rd.Count(8);
+      std::vector<int64_t> s;
+      s.reserve(nd);
+      for (uint32_t k = 0; k < nd; ++k) s.push_back(rd.I64());
+      b.shapes.push_back(std::move(s));
+    }
     bl.batches.push_back(std::move(b));
   }
   return bl;

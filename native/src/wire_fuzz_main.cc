@@ -48,8 +48,9 @@ RequestList RandRequestList() {
   size_t n = Rand(0, 8);
   for (size_t i = 0; i < n; ++i) {
     Request r;
-    r.kind = static_cast<OpKind>(Rand(0, 4));
-    r.dtype = static_cast<DType>(Rand(0, 9));
+    r.kind = static_cast<OpKind>(Rand(0, 6));
+    r.dtype = static_cast<DType>(Rand(0, 12));
+    r.op_code = static_cast<uint8_t>(Rand(0, 2));
     r.rank = static_cast<int32_t>(Rand(0, 1023));
     r.root_rank = static_cast<int32_t>(g_rng());
     r.group = static_cast<int64_t>(g_rng());
@@ -73,13 +74,23 @@ BatchList RandBatchList() {
   // the float round-trip is exact by construction.
   bl.tuned_cycle_ms =
       Rand(0, 3) == 0 ? -1.0 : static_cast<double>(Rand(0, 100000)) / 1000.0;
+  bl.last_joined = Rand(0, 3) == 0 ? -1 : static_cast<int32_t>(Rand(0, 511));
   size_t n = Rand(0, 8);
   for (size_t i = 0; i < n; ++i) {
     Batch b;
-    b.kind = static_cast<OpKind>(Rand(0, 4));
+    b.kind = static_cast<OpKind>(Rand(0, 6));
+    b.dtype = static_cast<DType>(Rand(0, 12));
+    b.op_code = static_cast<uint8_t>(Rand(0, 2));
     b.error = RandStr(30);
     size_t m = Rand(0, 6);
-    for (size_t j = 0; j < m; ++j) b.names.push_back(RandStr(24));
+    for (size_t j = 0; j < m; ++j) {
+      b.names.push_back(RandStr(24));
+      std::vector<int64_t> s;
+      size_t nd = Rand(0, 4);
+      for (size_t k = 0; k < nd; ++k)
+        s.push_back(static_cast<int64_t>(g_rng()));
+      b.shapes.push_back(std::move(s));
+    }
     bl.batches.push_back(std::move(b));
   }
   return bl;
@@ -90,9 +101,9 @@ bool EqualRL(const RequestList& a, const RequestList& b) {
     return false;
   for (size_t i = 0; i < a.requests.size(); ++i) {
     const Request &x = a.requests[i], &y = b.requests[i];
-    if (x.kind != y.kind || x.dtype != y.dtype || x.rank != y.rank ||
-        x.root_rank != y.root_rank || x.group != y.group ||
-        x.name != y.name || x.shape != y.shape)
+    if (x.kind != y.kind || x.dtype != y.dtype || x.op_code != y.op_code ||
+        x.rank != y.rank || x.root_rank != y.root_rank ||
+        x.group != y.group || x.name != y.name || x.shape != y.shape)
       return false;
   }
   return true;
@@ -102,11 +113,13 @@ bool EqualBL(const BatchList& a, const BatchList& b) {
   if (a.shutdown != b.shutdown || a.batches.size() != b.batches.size())
     return false;
   if (a.tuned_threshold_bytes != b.tuned_threshold_bytes ||
-      a.tuned_cycle_ms != b.tuned_cycle_ms)
+      a.tuned_cycle_ms != b.tuned_cycle_ms ||
+      a.last_joined != b.last_joined)
     return false;
   for (size_t i = 0; i < a.batches.size(); ++i) {
     const Batch &x = a.batches[i], &y = b.batches[i];
-    if (x.kind != y.kind || x.error != y.error || x.names != y.names)
+    if (x.kind != y.kind || x.dtype != y.dtype || x.op_code != y.op_code ||
+        x.error != y.error || x.names != y.names || x.shapes != y.shapes)
       return false;
   }
   return true;

@@ -1,0 +1,71 @@
+"""Worker: ``hvd.join()`` under real process separation — the uneven-data
+API Horovod grew in 0.21, on the native TCP control plane.
+
+Rank r has (r+1)*2 batches: rank 0 exhausts its data and joins while
+rank 1 keeps reducing — the joined rank must keep participating with
+zero contributions (its engine fabricates identity inputs from the
+batch's dtype/shape wire fields) so rank 1 never stalls.  join() returns
+the LAST rank to join (deterministically rank 1 here: its final
+allreduces can only complete after rank 0's join lands).  A second epoch
+proves the joined state resets; a broadcast attempted while a rank is
+joined must error cleanly, not hang.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    me, n = hvd.rank(), hvd.size()
+    assert n == 2, n
+
+    # --- Epoch 1: uneven data, rank 0 joins first.
+    steps = (me + 1) * 2
+    for i in range(steps):
+        out = hvd.allreduce(torch.full((4,), float(me + 1)), average=False,
+                            name=f"j.grad.{i}")
+        if i < 2:
+            # Both ranks active: 1 + 2.
+            assert torch.allclose(out, torch.full((4,), 3.0)), (i, out)
+        else:
+            # Rank 0 has joined; it contributes the Sum identity.
+            assert torch.allclose(out, torch.full((4,), 2.0)), (i, out)
+    last = hvd.join()
+    assert last == 1, last
+
+    # --- Epoch 2: the joined set reset; both ranks are active again.
+    out = hvd.allreduce(torch.full((2,), float(me)), average=True, name="j2")
+    assert torch.allclose(out, torch.full((2,), 0.5)), out
+
+    # --- Non-plain op while a rank is joined: clean symmetric error.
+    if me == 0:
+        last2 = hvd.join()
+        assert last2 == 1, last2
+    else:
+        try:
+            hvd.broadcast(torch.zeros(3), 0, name="j.bcast")
+            raise AssertionError("broadcast while joined did not error")
+        except RuntimeError as e:
+            assert "join" in str(e), e
+        last2 = hvd.join()
+        assert last2 == 1, last2
+
+    hvd.shutdown()
+    print("JOIN_OK " + json.dumps({"rank": me, "last": last}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

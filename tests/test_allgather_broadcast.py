@@ -135,6 +135,13 @@ def test_eager_reducescatter():
     np.testing.assert_allclose(avg, want / n, rtol=1e-6)
 
 
+def test_join_single_controller_trivial():
+    """hvd.join() in a single-controller world: every rank is driven by
+    this process, so all join simultaneously — returns size-1 immediately
+    (the multi-process semantics live in tests/test_multiprocess.py)."""
+    assert hvd.join() == hvd.size() - 1
+
+
 def test_eager_reducescatter_validates():
     n = hvd.size()
     bad = hvd.per_rank(lambda r: jnp.zeros((n + 1,), jnp.float32))

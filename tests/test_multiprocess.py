@@ -436,6 +436,23 @@ def test_gang4_ragged_process_sets_restart(tmp_path):
 
 
 @pytest.mark.slow
+def test_join_uneven_data_two_processes():
+    """hvd.join() (Horovod >=0.21) under real process separation: rank 0
+    exhausts its data and joins while rank 1 keeps reducing (zeros
+    fabricated from the batch wire), join() returns the last joiner, the
+    joined state resets per epoch, and non-plain ops error cleanly."""
+    outs = _run_workers(
+        os.path.join(HERE, "multiprocess_join_worker.py"), 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+        },
+    )
+    for i, out in enumerate(outs):
+        assert "JOIN_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
 def test_two_controllers_two_devices_each():
     """VERDICT r3 #7: the real pod shape — 2 processes × 2 virtual CPU
     devices each (multi-chip controllers), exercising rank()/local_*,
