@@ -96,6 +96,85 @@ def _note(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+# Worker-side stage tracking.  The r4 first-window postmortem: the TPU
+# worker claimed the chip in 7 s, then the tunnel wedged and its FIRST
+# remote dispatch blocked in C for 503 s until the orchestrator's
+# window-end kill — one wedged worker consumed the entire TPU window and
+# left no time for a retry that (with the chip re-grantable and the
+# compile cache warm) would likely have succeeded.  The stall watchdog
+# bounds every stage from inside the worker: no stage transition for
+# ``HVD_TPU_BENCH_STAGE_STALL`` seconds → dump all stacks, emit the
+# parseable failure line, exit.  The orchestrator treats that exit as
+# environmental (like its own watchdog) and re-claims.
+_STAGE = {"name": "spawn", "t0": _T_START, "limit": None,
+          "status_path": None, "line": None, "base": {}}
+
+
+def _set_stage(name: str, limit: float | None = None) -> None:
+    """Advance the stage marker (watchdog + status-file visibility).
+
+    ``limit`` overrides the default stall bound for stages with a
+    legitimately long silent phase (XLA compiles over the tunnel)."""
+    _STAGE["name"] = name
+    _STAGE["t0"] = time.monotonic()
+    _STAGE["limit"] = limit
+    _note(f"stage: {name}")
+    _checkpoint_status()
+
+
+def _checkpoint_status(extra: dict | None = None) -> None:
+    """Atomically mirror worker progress into the orchestrator-polled
+    status file: current stage, plus — once the primary arm has finished —
+    the newest complete result line (``partial_line``).  A worker killed
+    mid-extras then still yields its primary number (salvaged by
+    ``_run_worker``) instead of reducing the round to a CPU fallback."""
+    status_path = _STAGE["status_path"]
+    if not status_path:
+        return
+    payload = {"stage": _STAGE["name"]}
+    payload.update(_STAGE["base"])
+    payload.update(extra or {})
+    if _STAGE["line"] is not None:
+        payload["partial_line"] = _STAGE["line"]
+    with open(status_path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(status_path + ".tmp", status_path)
+
+
+def _compile_stall_limit() -> float:
+    """XLA compiles are the one legitimately long silent phase (measured
+    ~10-60 s over the remote-compile tunnel; headroom for the 101-layer
+    train step), so compile-shaped stages get a higher stall bound."""
+    return float(os.environ.get("HVD_TPU_BENCH_COMPILE_STALL", "240"))
+
+
+def _arm_stage_stall_watchdog() -> None:
+    """TPU-worker-only: tunnel wedges are an accelerator-path failure mode
+    (the pinned-CPU fallback can be slow — r2 measured ~260 s of compile —
+    but it cannot hang on a remote claim)."""
+    import threading
+
+    default_limit = float(
+        os.environ.get("HVD_TPU_BENCH_STAGE_STALL", "150"))
+
+    def watch() -> None:
+        while True:
+            time.sleep(5.0)
+            limit = _STAGE["limit"] or default_limit
+            stalled = time.monotonic() - _STAGE["t0"]
+            if stalled > limit:
+                import faulthandler
+
+                faulthandler.dump_traceback(file=sys.stderr)
+                print(_failure_line(
+                    f"worker stage stall: '{_STAGE['name']}' made no "
+                    f"progress for {stalled:.0f}s (limit {limit:.0f}s; "
+                    f"tunnel wedged after claim?)"), flush=True)
+                os._exit(0)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 # ──────────────────────────────────────────────────────────────────────────
 # Worker side — runs the actual measurements.  ONE backend init per process;
 # the orchestrator enforces the claim window and total budget from outside.
@@ -214,6 +293,12 @@ def _time_loop(step_once, num_iters: int, num_batches: int) -> float:
         for _ in range(num_batches):
             sync = step_once()
         _readback(sync)
+        # Each group ends in a real value readback — proof of forward
+        # progress.  Heartbeat the stall watchdog (without a stage
+        # transition) so a slow-but-healthy timing loop is never killed
+        # mid-measurement: only a group that itself exceeds the stall
+        # bound trips the watchdog.
+        _STAGE["t0"] = time.monotonic()
         rates.append(num_batches / (time.perf_counter() - t0))
     return sum(rates) / len(rates)
 
@@ -249,6 +334,7 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32
     )
 
+    _set_stage(f"resnet{depth}-data")
     global_bs = batch_per_chip * n
     # Random synthetic data, not constants: a constant operand is an
     # invitation for XLA to simplify work away, and a throughput number
@@ -264,6 +350,7 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
     # Jit the init: unjitted flax init dispatches hundreds of tiny ops,
     # each a round-trip through the remote-compile tunnel (~2 min measured
     # for ResNet-50 bring-up on the real chip vs one ~10 s compile jitted).
+    _set_stage(f"resnet{depth}-init-compile", limit=_compile_stall_limit())
     variables = jax.jit(model.init, static_argnames="train")(
         jax.random.key(0), images[:1], train=False
     )
@@ -283,7 +370,7 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
 
     tx = hvd.DistributedOptimizer(optax.sgd(0.01 * n, momentum=0.9))
     opt_state = jax.jit(tx.init)(params)  # one compile, not a dispatch per leaf
-    _note(f"resnet{depth}: inputs+params ready, compiling")
+    _set_stage(f"resnet{depth}-step-compile", limit=_compile_stall_limit())
     step, flops, out = _aot_compile(
         # donate: real training reuses the params/opt buffers every step;
         # benchmarking without donation would overstate HBM pressure and
@@ -291,7 +378,7 @@ def _bench_resnet(hvd, on_tpu: bool, *, depth: int = 101,
         hvd.make_train_step(loss_fn, tx, donate=on_tpu),
         params, opt_state, (images, labels),
     )
-    _note(f"resnet{depth}: compiled+warm, timing")
+    _set_stage(f"resnet{depth}-timing")
     state = {"p": out.params, "o": out.opt_state}
 
     def one():
@@ -654,6 +741,10 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     ``JAX_PLATFORMS=cpu``)."""
     budget_s = float(os.environ.get("HVD_TPU_BENCH_BUDGET", "420"))
 
+    _STAGE["status_path"] = status_path
+    if mode == "tpu":
+        _arm_stage_stall_watchdog()
+
     import jax
 
     # Persistent compilation cache: the first compile of each arm costs
@@ -675,16 +766,14 @@ def _worker_main(mode: str, status_path: str | None) -> None:
         # (same trick as tests/conftest.py).
         jax.config.update("jax_platforms", "cpu")
 
+    _set_stage("backend-claim")
     backend = jax.default_backend()       # ← the claim; may hang (killed
     device_kind = jax.devices()[0].device_kind       # from outside)
-    if status_path:
-        # Atomic write: the orchestrator polls this file against the claim
-        # deadline, and a partial read must not make it kill a worker that
-        # already holds the exclusive grant (the retry would then hang).
-        with open(status_path + ".tmp", "w") as f:
-            json.dump({"stage": "claimed", "backend": backend,
-                       "device_kind": device_kind}, f)
-        os.replace(status_path + ".tmp", status_path)
+    # The orchestrator polls the status file against the claim deadline;
+    # only a payload carrying ``backend`` counts as the claim (stage-only
+    # writes land earlier and must not defuse the claim timeout).
+    _STAGE["base"] = {"backend": backend, "device_kind": device_kind}
+    _set_stage("claimed")
     on_tpu = backend != "cpu"
     if mode == "tpu" and not on_tpu:
         # Ambient env resolved to plain CPU: no accelerator plugin is
@@ -702,6 +791,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
 
     import horovod_tpu as hvd
 
+    _set_stage("hvd-init")
     hvd.init()
     result = _bench_resnet(hvd, on_tpu)
     _note(f"resnet done: {result}")
@@ -713,10 +803,32 @@ def _worker_main(mode: str, status_path: str | None) -> None:
         "n_chips": hvd.size(),
         "resnet101_flops_per_step_per_chip": result["flops_per_step"],
     }
+    # The primary line exists (and is checkpointed into the status file)
+    # the moment the primary arm completes: every later kill — budget,
+    # window end, driver timeout — salvages this number instead of
+    # downgrading the round to a CPU fallback.
+    line = {
+        "metric": _METRIC,
+        "value": per_chip,
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }
+    if result["mfu"] is not None:
+        line["mfu"] = round(result["mfu"], 4)
+        if result["mfu"] > 1.0:
+            extras["mfu_note"] = (
+                "MFU>1 is impossible on one chip: either the device-kind→"
+                "peak-FLOPs mapping mismatches the executing hardware or "
+                "more than one chip ran the step.  Treat `value` as "
+                "unreliable; see docs/benchmarks.md 'Reading MFU'."
+            )
+    line["extras"] = extras
+    _STAGE["line"] = line
     if backend != "cpu":
         # Gate on the REAL backend, not the force-flag-overridden on_tpu:
         # a CPU rehearsal recording local dispatch latency as "tunnel RTT"
         # would read as a 100x tunnel speedup round-over-round.
+        _set_stage("tunnel-rtt")
         try:
             extras["tunnel_rtt_ms"] = _measure_rtt_ms()
         except Exception as exc:
@@ -746,28 +858,17 @@ def _worker_main(mode: str, status_path: str | None) -> None:
         if time.monotonic() - _T_START > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue
+        # Every extras arm compiles at least one new executable, so each
+        # gets the compile-grade stall bound.
+        _set_stage(fn.__name__, limit=_compile_stall_limit())
         try:
             extras.update(fn(hvd, on_tpu))
             _note(f"{fn.__name__} done")
         except Exception as exc:  # a failed extra never kills the line
             extras[fn.__name__ + "_error"] = f"{type(exc).__name__}: {exc}"
+        _checkpoint_status()
 
-    line = {
-        "metric": _METRIC,
-        "value": per_chip,
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-    }
-    if result["mfu"] is not None:
-        line["mfu"] = round(result["mfu"], 4)
-        if result["mfu"] > 1.0:
-            extras["mfu_note"] = (
-                "MFU>1 is impossible on one chip: either the device-kind→"
-                "peak-FLOPs mapping mismatches the executing hardware or "
-                "more than one chip ran the step.  Treat `value` as "
-                "unreliable; see docs/benchmarks.md 'Reading MFU'."
-            )
-    line["extras"] = extras
+    _set_stage("final-line")
     print(json.dumps(line), flush=True)
 
 
@@ -845,6 +946,7 @@ def _run_worker(mode: str, claim_timeout: float, total_timeout: float,
             )
         t_spawn = time.monotonic()
         claimed = False
+        last_stage = None
         outcome = ""
 
         def _stderr_tail() -> str:
@@ -856,21 +958,48 @@ def _run_worker(mode: str, claim_timeout: float, total_timeout: float,
             except OSError:
                 return ""
 
+        def _read_status() -> dict | None:
+            try:
+                with open(status_path) as f:
+                    return json.load(f)
+            except Exception:
+                return None   # absent, or pre-rename race; re-read later
+
+        def _salvage(kill_reason: str) -> dict | None:
+            """A killed worker whose status file already carries the
+            completed primary line still counts: return that line with the
+            kill recorded, instead of degrading the round to CPU."""
+            st = _read_status()
+            if st is None or "partial_line" not in st:
+                return None
+            salvaged = st["partial_line"]
+            salvaged.setdefault("extras", {})["salvaged"] = (
+                f"worker killed during stage '{st.get('stage')}': "
+                f"{kill_reason}")
+            return salvaged
+
         while True:
             rc = proc.poll()
             if rc is not None:
                 break
             waited = time.monotonic() - t_spawn
-            if not claimed and os.path.exists(status_path):
-                try:
-                    with open(status_path) as f:
-                        st = json.load(f)
+            st = _read_status()
+            if st is not None:
+                # Stage transitions go to the orchestrator log live, so a
+                # killed window names where time went without exhuming the
+                # worker's stderr.
+                if st.get("stage") != last_stage:
+                    last_stage = st.get("stage")
+                    _note(f"worker[{mode}] stage: {last_stage} "
+                          f"(+{waited:.0f}s)")
+                # Only a payload with the backend fields is the claim —
+                # stage-only writes land before PJRT_Client_Create and
+                # must not defuse the claim timeout.
+                if not claimed and st.get("backend"):
                     claimed = True
                     _note(f"worker[{mode}] claimed backend "
                           f"{st.get('backend')}/{st.get('device_kind')} "
                           f"after {waited:.0f}s")
-                except Exception:
-                    pass  # pre-rename race; next poll re-reads
             if not claimed and waited > claim_timeout:
                 proc.kill()
                 proc.wait()
@@ -881,8 +1010,8 @@ def _run_worker(mode: str, claim_timeout: float, total_timeout: float,
                 proc.kill()
                 proc.wait()
                 outcome = (f"ran past total window {total_timeout:.0f}s "
-                           f"(killed mid-bench); stderr tail: "
-                           f"{_stderr_tail()}")
+                           f"(killed mid-bench at stage '{last_stage}'); "
+                           f"stderr tail: {_stderr_tail()}")
                 break
             time.sleep(1.0)
         out = proc.stdout.read().decode(errors="replace") if proc.stdout else ""
@@ -895,6 +1024,17 @@ def _run_worker(mode: str, claim_timeout: float, total_timeout: float,
                     break
                 except json.JSONDecodeError:
                     continue
+        if line is None and outcome:
+            line = _salvage(outcome)
+            if line is not None:
+                return line, "ok (salvaged primary line after kill)"
+        if line is not None and "error" in line:
+            # A stall/watchdog failure line from a worker that had already
+            # finished the primary arm: prefer the completed number over
+            # the failure artifact (the error is recorded in `salvaged`).
+            salvaged = _salvage(line["error"])
+            if salvaged is not None:
+                return salvaged, "ok (salvaged primary line after stall)"
         if line is None and not outcome:
             outcome = (f"worker exited rc={proc.returncode} with no JSON "
                        f"line; stderr tail: {_stderr_tail()}")
@@ -990,7 +1130,8 @@ def _orchestrate() -> None:
                     print(json.dumps(line), flush=True)
                     return
                 probe["outcomes"][-1] += f"; worker error: {line['error']}"
-                if not line["error"].startswith("worker watchdog"):
+                if not (line["error"].startswith("worker watchdog")
+                        or line["error"].startswith("worker stage stall")):
                     # A Python exception after the claim is deterministic
                     # (bad knob value, model bug): re-claiming and
                     # re-compiling just to hit it again would burn the

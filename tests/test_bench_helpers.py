@@ -185,3 +185,81 @@ def test_preserved_window_artifact_surfacing(bench, tmp_path, monkeypatch):
     got = bench._preserved_window_artifact()
     assert got is not None and got["value"] == 2000.0
     assert got["artifact_path"].endswith("BENCH_window_111.json")
+
+
+def test_stage_stall_watchdog_fires_in_subprocess(tmp_path):
+    """The r4 wedged-tunnel fix: a worker whose stage stops advancing must
+    exit with the parseable 'worker stage stall' failure line instead of
+    holding the claim until the window-end kill (bench.py postmortem:
+    7 s claim + 503 s wedge consumed the whole first TPU window)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import importlib.util, os, sys, time\n"
+        f"spec = importlib.util.spec_from_file_location('bench', "
+        f"{os.path.join(repo, 'bench.py')!r})\n"
+        "bench = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(bench)\n"
+        "bench._STAGE['status_path'] = sys.argv[1]\n"
+        "bench._arm_stage_stall_watchdog()\n"
+        "bench._set_stage('wedged-dispatch')\n"
+        "time.sleep(60)\n"          # the watchdog must win long before this
+    )
+    status = tmp_path / "status.json"
+    out = subprocess.run(
+        [sys.executable, "-c", script, str(status)],
+        env={**os.environ, "HVD_TPU_BENCH_STAGE_STALL": "2",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=45,
+    )
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["error"].startswith("worker stage stall: 'wedged-dispatch'")
+    assert line["value"] == 0.0
+    # The stall dump names the wedged frame for the postmortem.
+    assert "time.sleep" in out.stderr or "Thread" in out.stderr
+    # Stage-only status writes must NOT carry backend fields (the claim
+    # sentinel the orchestrator keys on).
+    st = json.loads(status.read_text())
+    assert st["stage"] == "wedged-dispatch" and "backend" not in st
+
+
+def test_run_worker_salvages_partial_line(bench, tmp_path, monkeypatch):
+    """A worker killed mid-extras after checkpointing its primary line
+    yields that line (with the kill recorded), not a CPU fallback."""
+    import subprocess
+
+    real_popen = subprocess.Popen
+
+    fake_worker = (
+        "import json, os, sys, time\n"
+        "i = sys.argv.index('--status-file'); path = sys.argv[i + 1]\n"
+        "line = {'metric': 'm', 'value': 123.0, 'unit': 'u',\n"
+        "        'vs_baseline': 1.19,\n"
+        "        'extras': {'backend': 'tpu', 'device_kind': 'TPU v5 lite'}}\n"
+        "with open(path + '.tmp', 'w') as f:\n"
+        "    json.dump({'stage': 'llama', 'backend': 'tpu',\n"
+        "               'device_kind': 'TPU v5 lite',\n"
+        "               'partial_line': line}, f)\n"
+        "os.replace(path + '.tmp', path)\n"
+        "time.sleep(120)\n"          # wedged in extras; never prints JSON
+    )
+
+    def popen_fake(cmd, **kw):
+        # Replace the real worker invocation with the wedge-after-primary
+        # simulator; keep the orchestrator's plumbing (status file arg
+        # parsing, stdout pipe, kill path) fully real.
+        idx = cmd.index("--status-file")
+        return real_popen(
+            [sys.executable, "-c", fake_worker, "--status-file", cmd[idx + 1]],
+            **kw)
+
+    monkeypatch.setattr(subprocess, "Popen", popen_fake)
+    # total_timeout must outlive interpreter startup under a loaded box
+    # (the full suite runs files in parallel with compile-heavy peers) but
+    # stay far below the fake worker's 120 s sleep.
+    line, outcome = bench._run_worker("tpu", claim_timeout=30,
+                                      total_timeout=12)
+    assert outcome.startswith("ok (salvaged")
+    assert line["value"] == 123.0
+    assert "killed during stage 'llama'" in line["extras"]["salvaged"]

@@ -30,7 +30,15 @@ while true; do
         > "$OUT/flash_${ts}.log" 2>&1; then
     echo "window at $ts (attempt $n)" >> "$OUT/WINDOW"
     sleep 10   # let the claim release cleanly before the bench worker dials
-    ( cd "$REPO" && timeout 1000 python bench.py \
+    # Wider ledger than the driver's defaults: the watcher owns its own
+    # timeout (1000 s), so give the orchestrator most of it and shrink the
+    # CPU reserve — a watcher run that falls back to CPU is worthless
+    # anyway (the driver's own run produces that artifact).
+    # 920 (not 940): the orchestrator's last-resort watchdog arms at
+  # HARD_LIMIT+60 and must fire — and print its parseable failure line —
+  # BEFORE the outer `timeout 1000` SIGTERMs the process.
+  ( cd "$REPO" && HVD_TPU_BENCH_HARD_LIMIT=920 \
+        HVD_TPU_BENCH_CPU_RESERVE=120 timeout 1000 python bench.py \
         > "$OUT/bench_${ts}.json" 2> "$OUT/bench_${ts}.log" )
     # Only a bench that actually executed on the accelerator ends the
     # watch: the window can close between the flash check's clean exit and
@@ -39,12 +47,21 @@ while true; do
     if grep '"backend":' "$OUT/bench_${ts}.json" \
         | grep -qv '"backend": "cpu"'; then
       touch "$OUT/DONE"
+      # Persist the catch NOW — before spending the window on anything
+      # else (r4 lesson: the sweep can outlive the window, and an
+      # unharvested /tmp artifact helps nobody).  harvest_window.py names
+      # the bench copy BENCH_window_*.json, which bench.py's CPU-fallback
+      # path attaches to the driver's end-of-round artifact.
+      python "$REPO/tools/harvest_window.py" --src "$OUT" \
+          >> "$OUT/daemon.log" 2>&1
       # Window still open?  Spend it on tuning data: the sweep self-bounds
       # per stage, prints a parseable RESULT line per config, and shares
       # the persistent compile cache with the bench it just warmed.
       sleep 10
       STAGE_TIMEOUT=240 timeout 1800 python "$REPO/tools/tpu_perf_sweep.py" \
           > "$OUT/sweep_${ts}.log" 2>&1
+      python "$REPO/tools/harvest_window.py" --src "$OUT" \
+          >> "$OUT/daemon.log" 2>&1
       exit 0
     fi
   fi

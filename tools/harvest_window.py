@@ -36,8 +36,13 @@ def main() -> int:
             continue
         backend = line.get("extras", {}).get("backend")
         if backend and backend != "cpu":
+            # Name MUST match bench.py's `_preserved_window_artifact` glob
+            # (BENCH_window_*.json): the driver's CPU-fallback line attaches
+            # the newest of these, which is how a watcher-caught window
+            # reaches the round artifact when the end-of-round run misses.
             dst = os.path.join(
-                args.out, "r3_live_bench_honest_" + os.path.basename(p))
+                args.out, "BENCH_window_" + os.path.basename(p)
+                .removeprefix("bench_"))
             if not os.path.exists(dst):
                 shutil.copy(p, dst)
             landed.append((dst, f"backend={backend} value={line.get('value')} "
@@ -47,7 +52,7 @@ def main() -> int:
         text = open(p, errors="replace").read()
         if "CORRECTNESS:" in text:
             dst = os.path.join(
-                args.out, "r3_flash_oncheck_" + os.path.basename(p))
+                args.out, "window_flash_" + os.path.basename(p))
             if not os.path.exists(dst):
                 shutil.copy(p, dst)
             verdict = re.search(r"CORRECTNESS: \w+", text)
@@ -58,7 +63,7 @@ def main() -> int:
                    if ln.startswith("RESULT ")]
         if results:
             dst = os.path.join(
-                args.out, "r3_perf_sweep_" + os.path.basename(p))
+                args.out, "window_sweep_" + os.path.basename(p))
             if not os.path.exists(dst):
                 shutil.copy(p, dst)
             landed.append((dst, f"{len(results)} configs"))

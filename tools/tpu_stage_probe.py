@@ -1,0 +1,117 @@
+"""Stage-by-stage timing of the bench's ResNet bring-up on the live chip.
+
+Round-4 forensics: the r4 first-window bench worker claimed the TPU in 7 s
+and was then killed 503 s later having never reached the
+"inputs+params ready" note inside ``_bench_resnet`` (bench.py).  Every
+stage between the claim and that note is timed here individually, and a
+``faulthandler.dump_traceback_later`` fires a full-stack dump every 120 s
+so a silent hang names the exact frame (the r3 lesson: bound from
+outside, inspect from inside).
+
+Usage (run it under ``timeout`` — a hung PJRT call ignores SIGINT):
+
+    timeout 900 python tools/tpu_stage_probe.py
+"""
+
+import faulthandler
+import os
+import sys
+import time
+
+faulthandler.dump_traceback_later(120, repeat=True, file=sys.stderr)
+
+_T0 = time.monotonic()
+
+
+def note(msg: str) -> None:
+    print(f"[probe +{time.monotonic() - _T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+note("importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+note("enabling compile cache")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from horovod_tpu.utils.env import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".jax_cache"))
+
+note("claiming backend")
+backend = jax.default_backend()
+note(f"claimed backend={backend} device={jax.devices()[0].device_kind}")
+
+note("importing horovod_tpu")
+import horovod_tpu as hvd  # noqa: E402
+
+note("hvd.init()")
+hvd.init()
+note(f"hvd.init done; size={hvd.size()}")
+
+import optax  # noqa: E402
+
+import horovod_tpu.models.resnet as resnet_mod  # noqa: E402
+
+depth = int(os.environ.get("PROBE_DEPTH", "101"))
+bs = int(os.environ.get("PROBE_BS", "64"))
+img = int(os.environ.get("PROBE_IMG", "224"))
+model = getattr(resnet_mod, f"ResNet{depth}")(dtype=jnp.bfloat16)
+
+note(f"generating synthetic data bs={bs} img={img}")
+kimg, klab = jax.random.split(jax.random.key(7))
+images = jax.random.normal(kimg, (bs, img, img, 3), jnp.float32)
+labels = jax.random.randint(klab, (bs,), 0, 1000, jnp.int32)
+jax.block_until_ready((images, labels))
+note("synthetic data materialized on device")
+
+note(f"jitting model.init (ResNet-{depth})")
+variables = jax.jit(model.init, static_argnames="train")(
+    jax.random.key(0), images[:1], train=False
+)
+jax.block_until_ready(variables)
+note("model.init done")
+params, batch_stats = variables["params"], variables["batch_stats"]
+
+
+def loss_fn(p, batch):
+    x, y = batch
+    logits, _ = model.apply(
+        {"params": p, "batch_stats": batch_stats},
+        x, train=True, mutable=["batch_stats"],
+    )
+    onehot = jax.nn.one_hot(y, logits.shape[-1])
+    return optax.softmax_cross_entropy(logits, onehot).mean()
+
+
+note("tx.init")
+tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+opt_state = jax.jit(tx.init)(params)
+jax.block_until_ready(opt_state)
+note("tx.init done; lowering train step")
+
+step_fn = hvd.make_train_step(loss_fn, tx, donate=True)
+lowered = step_fn.lower(params, opt_state, (images, labels))
+note("lowered; compiling")
+compiled = lowered.compile()
+note("compiled; warmup step")
+out = compiled(params, opt_state, (images, labels))
+jax.block_until_ready(out.loss)
+note(f"warmup done, loss={float(out.loss):.3f}")
+
+state = {"p": out.params, "o": out.opt_state}
+for group in range(3):
+    t0 = time.perf_counter()
+    for _ in range(10):
+        r = compiled(state["p"], state["o"], (images, labels))
+        state["p"], state["o"] = r.params, r.opt_state
+    float(r.loss)          # value readback fence
+    dt = time.perf_counter() - t0
+    note(f"group {group}: 10 steps in {dt:.3f}s -> "
+         f"{10 * bs / dt:.1f} img/s")
+
+note("probe complete")
+faulthandler.cancel_dump_traceback_later()
