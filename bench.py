@@ -850,9 +850,11 @@ def _worker_main(mode: str, status_path: str | None) -> None:
         extras["tpu_unavailable_fell_back_to_cpu"] = True
     # Optional sub-benchmarks, each fenced by the remaining time budget so
     # the primary JSON line is never lost to a driver timeout.
-    # New arms go LAST: under the budget fence, the arms earlier rounds
-    # already recorded (llama/fusion) keep priority for comparability.
-    for fn in (_bench_llama, _bench_fusion, _bench_llama_fused,
+    # Order = evidence priority under a tight window: the fusion A/B is
+    # the headline Horovod knob (reference operations.cc:1916-1943) whose
+    # on-chip win is still unproven (VERDICT r3 #2), so it runs first;
+    # then the llama arms earlier rounds recorded, then newer arms.
+    for fn in (_bench_fusion, _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_resnet101_big_batch,
                _bench_llama_decode):
         if time.monotonic() - _T_START > budget_s:

@@ -67,6 +67,20 @@ class NotInitializedError(RuntimeError):
     """
 
 
+class HorovodInternalError(RuntimeError):
+    """An ENVIRONMENTAL collective failure: the control plane broke, the
+    engine was shut down underneath in-flight ops, or a peer vanished
+    mid-negotiation — the failures :mod:`horovod_tpu.elastic` recovers
+    from by re-initializing and replaying from the last committed state.
+
+    Deterministic caller mistakes (shape/dtype mismatch between ranks,
+    invalid arguments) stay plain ``ValueError``/``RuntimeError`` —
+    retrying those would loop forever.  Name-parity with the exception
+    Horovod's elastic mode keys on (its 0.20+ ``HorovodInternalError``;
+    the 0.15.1 reference's closest analogue is the SHUT_DOWN_ERROR
+    callback status, operations.cc:278-283)."""
+
+
 class _State:
     """Global framework state — the analogue of ``HorovodGlobalState``
     (reference horovod/common/operations.cc:112-264), minus everything XLA

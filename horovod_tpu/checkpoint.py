@@ -112,20 +112,33 @@ def wait_for_checkpoints() -> None:
         _async_checkpointer.wait_until_finished()
 
 
-def latest_checkpoint(path: str) -> str | None:
-    """Find the newest ``step_N`` checkpoint under ``path`` (the resume scan
-    of reference keras_imagenet_resnet50.py:66-70), agreed across hosts."""
+def list_checkpoints(path: str) -> list:
+    """All ``step_N`` checkpoints under ``path``, NEWEST FIRST, agreed
+    across hosts (root scans its disk; everyone adopts root's view — the
+    rank-0 write convention means non-root disks may hold nothing).
+
+    Callers that must survive a torn checkpoint (a gang killed mid-write)
+    walk this list and fall back — :meth:`horovod_tpu.elastic.State.restore`
+    does; ``restore_checkpoint`` raises in agreement on every rank, so the
+    walk stays in lockstep."""
     basics._require_init()
-    found = None
+    found: list = []
     if basics.cross_rank() == _root_process(0) and os.path.isdir(path):
         steps = []
         for entry in os.listdir(path):
             m = re.fullmatch(r"step_(\d+)", entry)
             if m:
                 steps.append(int(m.group(1)))
-        if steps:
-            found = os.path.join(os.path.abspath(path), f"step_{max(steps)}")
+        found = [os.path.join(os.path.abspath(path), f"step_{s}")
+                 for s in sorted(steps, reverse=True)]
     return broadcast_object(found, root_rank=0)
+
+
+def latest_checkpoint(path: str) -> str | None:
+    """Find the newest ``step_N`` checkpoint under ``path`` (the resume scan
+    of reference keras_imagenet_resnet50.py:66-70), agreed across hosts."""
+    found = list_checkpoints(path)
+    return found[0] if found else None
 
 
 def restore_checkpoint(path: str, template: Any = None, *, root_rank: int = 0) -> Any:

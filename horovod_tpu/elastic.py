@@ -1,0 +1,261 @@
+"""Elastic / fault-tolerant training — the ``hvd.elastic`` API Horovod
+grew in 0.20, re-shaped for TPU gangs.  BEYOND the 0.15.1 reference,
+which has only stall *detection* (operations.cc:1424-1470) and clean
+shutdown propagation (:1699-1729); see SURVEY §2.3's "Elastic" row.
+
+TPU-native shape
+----------------
+GPU-era elastic keeps surviving processes alive and renegotiates a
+smaller ring.  A TPU slice does not work that way: losing a worker means
+losing its chips, and the platform reschedules the WHOLE slice — so gang
+supervision belongs to the launcher (``horovod_tpu.launch --restarts N``
+tears down and relaunches the entire gang on any worker death), and
+elastic state must survive *process* death, not just collective failure.
+Hence :class:`State` commits through the rank-0 orbax checkpoint pipeline
+(:mod:`horovod_tpu.checkpoint`, async writes), and every (re)start of a
+:func:`run`-wrapped function resumes from the newest commit.
+
+In-process retry still exists for failures that do NOT kill the process
+— a broken control plane, a shutdown response racing in-flight ops —
+surfaced as :class:`~horovod_tpu.basics.HorovodInternalError`:
+:func:`run` re-initializes the engine, restores the last commit, and
+replays.  Deterministic caller mistakes (shape mismatches, bad
+arguments) are plain ``ValueError``/``RuntimeError`` and propagate.
+
+Usage (mirrors horovod.elastic; note the advance-THEN-commit shape —
+progress counters are incremented before the commit so a restore never
+replays work the commit already covers)::
+
+    state = hvd.elastic.State(ckpt_dir="/ckpts/run1",
+                              params=params, opt_state=opt_state,
+                              epoch=0, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < epochs:
+            while state.batch < batches:
+                state.params, state.opt_state, loss = step(
+                    state.params, state.opt_state, data[state.batch])
+                state.batch += 1
+                if state.batch % 10 == 0:
+                    state.commit()
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable
+
+import jax
+
+from horovod_tpu import basics, checkpoint
+from horovod_tpu.basics import HorovodInternalError
+from horovod_tpu.optim.distributed_optimizer import broadcast_optimizer_state
+
+__all__ = ["State", "run", "HorovodInternalError"]
+
+# Key under which State stores its own bookkeeping inside the committed
+# tree (kept alongside user fields so one checkpoint is one commit).
+_META = "__elastic__"
+
+
+class State:
+    """Named training state with commit / restore / sync semantics.
+
+    ``fields`` are arbitrary pytrees (params, opt_state) or plain Python
+    scalars (epoch, batch) — accessed as attributes.  ``commit()``
+    snapshots them; ``restore()`` rolls back to the newest commit;
+    ``sync()`` broadcasts the current values from the root process so a
+    freshly (re)started gang agrees bit-for-bit.
+
+    With ``ckpt_dir`` commits are durable (rank-0 async orbax writes — the
+    reference's rank-0 checkpoint convention) and survive a launcher gang
+    relaunch.  Without it commits live in host memory only: enough for
+    in-process retry, gone with the process.
+    """
+
+    def __init__(self, ckpt_dir: str | None = None, *,
+                 sync_commits: bool = False, **fields: Any) -> None:
+        if not fields:
+            raise ValueError("State needs at least one field, e.g. "
+                             "State(params=params, epoch=0)")
+        for k in fields:
+            if k.startswith("_") or k == _META:
+                raise ValueError(f"reserved field name: {k!r}")
+        object.__setattr__(self, "_fields", dict(fields))
+        object.__setattr__(self, "_ckpt_dir",
+                           os.path.abspath(ckpt_dir) if ckpt_dir else None)
+        # sync_commits=True makes commit() block until the write is on
+        # disk: slower, but the commit is durable the moment it returns —
+        # the right trade when the supervisor may SIGTERM the gang at any
+        # moment (preemptible capacity).  (A reserved kwarg, not a field.)
+        object.__setattr__(self, "_sync_commits", bool(sync_commits))
+        object.__setattr__(self, "_mem_commit", None)
+        object.__setattr__(self, "_commit_step", 0)
+
+    # Attribute-style access to fields (state.params, state.epoch = 3).
+    def __getattr__(self, name: str) -> Any:
+        fields = object.__getattribute__(self, "_fields")
+        if name in fields:
+            return fields[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in object.__getattribute__(self, "_fields"):
+            object.__getattribute__(self, "_fields")[name] = value
+        else:
+            raise AttributeError(
+                f"unknown state field {name!r}; declare every field in "
+                f"State(...) so commits stay complete")
+
+    @property
+    def commit_step(self) -> int:
+        """Monotonic count of commits (0 = never committed)."""
+        return object.__getattribute__(self, "_commit_step")
+
+    def _tree(self) -> dict:
+        return {**object.__getattribute__(self, "_fields"),
+                _META: {"commit_step": self.commit_step}}
+
+    def commit(self) -> None:
+        """Snapshot the current field values as the rollback/resume point.
+
+        Host-memory snapshot always (``jax.device_get`` — a device-only
+        snapshot would die with the engine on reinit); with ``ckpt_dir``
+        also a durable rank-0 async checkpoint.  Async: the write costs a
+        device→host copy up front, the disk I/O overlaps training
+        (checkpoint.save_checkpoint); call sparingly — everything since
+        the last commit is redone after a failure."""
+        object.__setattr__(self, "_commit_step", self.commit_step + 1)
+        snap = jax.device_get(self._tree())
+        object.__setattr__(self, "_mem_commit", snap)
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir:
+            checkpoint.save_checkpoint(
+                ckpt_dir, snap, step=self.commit_step,
+                async_save=not object.__getattribute__(self, "_sync_commits"))
+
+    def sync(self) -> None:
+        """Broadcast every field from the root process (reference resume
+        recipe: load on rank 0 then broadcast_parameters,
+        pytorch_imagenet_resnet50.py:134-142)."""
+        # broadcast_optimizer_state (not broadcast_parameters): state
+        # trees mix arrays with Python scalars (epoch/batch counters), and
+        # it restores the scalar types after the wire trip.
+        self._adopt(broadcast_optimizer_state(self._tree(), root_rank=0))
+
+    def restore(self) -> None:
+        """Adopt the newest commit, agreed across the gang.
+
+        Priority: durable checkpoint (survives process death) → in-memory
+        snapshot (in-process retry) → plain :meth:`sync` of the initial
+        values (first-ever start).  Always ends with every rank holding
+        identical values."""
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir:
+            checkpoint.wait_for_checkpoints()   # a mid-flight async commit
+            template = jax.device_get(self._tree())
+            # Newest first, falling back past torn checkpoints (a gang
+            # SIGTERMed mid-write leaves a partial step_N dir).  Every
+            # rank raises or succeeds in agreement inside
+            # restore_checkpoint, so the walk stays in lockstep.
+            for cand in checkpoint.list_checkpoints(ckpt_dir):
+                try:
+                    self._adopt(checkpoint.restore_checkpoint(
+                        cand, template=template))
+                    return
+                except HorovodInternalError:
+                    # An environmental collective failure mid-restore is
+                    # NOT a torn checkpoint: falling back here would
+                    # silently resume from an older commit (and later
+                    # commits would overwrite the newer good one).
+                    # Propagate so run()'s retry reinits and re-attempts
+                    # the NEWEST commit.
+                    raise
+                except RuntimeError:
+                    continue
+        mem = object.__getattribute__(self, "_mem_commit")
+        if mem is not None:
+            # The snapshot is process-local host memory; the broadcast
+            # inside sync() re-establishes cross-rank agreement (ranks
+            # may have diverged unevenly before the failure).
+            self._adopt(mem)
+        self.sync()
+
+    def _adopt(self, tree: dict) -> None:
+        meta = tree.get(_META, {})
+        object.__setattr__(
+            self, "_commit_step", int(meta.get("commit_step",
+                                               self.commit_step)))
+        fields = object.__getattribute__(self, "_fields")
+
+        def _coerce(cur: Any, new: Any) -> Any:
+            # Durable restores (orbax) come back as read-only numpy
+            # arrays, including 0-d ones for fields declared as Python
+            # scalars — `state.epoch += 1` would then die on "output
+            # array is read-only".  Leaves declared as plain scalars are
+            # cast back to their declared type (same restoration
+            # broadcast_optimizer_state does after its wire trip).
+            if isinstance(cur, (bool, int, float)):
+                return type(cur)(new)
+            return new
+
+        for k in fields:
+            if k in tree:
+                try:
+                    fields[k] = jax.tree.map(_coerce, fields[k], tree[k])
+                except (ValueError, TypeError):
+                    # Structure drift (a field re-shaped between runs):
+                    # adopt verbatim rather than refusing the commit.
+                    fields[k] = tree[k]
+
+
+def _reinit() -> None:
+    """Tear the engine down (tolerating an already-dead one) and bring it
+    back up for the retry."""
+    import horovod_tpu as hvd
+
+    try:
+        hvd.shutdown()
+    except Exception:
+        pass
+    hvd.init()
+
+
+def run(fn: Callable) -> Callable:
+    """Decorator: make ``fn(state, ...)`` survive environmental collective
+    failures (:class:`HorovodInternalError`) by reinit → restore → replay,
+    up to ``HOROVOD_TPU_ELASTIC_RETRIES`` times (default 3).
+
+    On entry the state is restored — so under a launcher gang relaunch
+    (``horovod_tpu.launch --restarts``) the fresh process resumes from the
+    newest durable commit with no extra code, and a first-ever start just
+    syncs the initial values from root.  Mirrors ``horovod.elastic.run``
+    (Horovod 0.20+)."""
+
+    @functools.wraps(fn)
+    def wrapper(state: State, *args: Any, **kwargs: Any) -> Any:
+        if not isinstance(state, State):
+            raise TypeError("first argument to an elastic.run function "
+                            "must be an elastic.State")
+        basics._require_init()
+        retries = int(os.environ.get("HOROVOD_TPU_ELASTIC_RETRIES", "3"))
+        state.restore()
+        attempt = 0
+        while True:
+            try:
+                return fn(state, *args, **kwargs)
+            except HorovodInternalError:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                _reinit()
+                state.restore()
+
+    return wrapper

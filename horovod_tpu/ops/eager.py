@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu import basics, timeline as timeline_mod
-from horovod_tpu.basics import AXIS_NAME
+from horovod_tpu.basics import AXIS_NAME, HorovodInternalError
 from horovod_tpu.ops import collective_ops
 from horovod_tpu.ops.collective_ops import Average, Sum, _ReduceOp
 from horovod_tpu.ops.compression import Compression, TopKCompressor
@@ -326,7 +326,8 @@ class EagerEngine:
                     self.timeline.instant(p.name, "NEGOTIATE_TICK_ALL")
         with self._lock:
             if self._shutdown.is_set():
-                raise RuntimeError("horovod_tpu engine has been shut down")
+                raise HorovodInternalError(
+                    "horovod_tpu engine has been shut down")
             self._queue.extend(pendings)
             self.stats["ops_enqueued"] += len(pendings)
 
@@ -544,12 +545,16 @@ class EagerEngine:
             bl = self.controller.tick()
         except Exception as e:
             # A broken control plane strands every outstanding op; fail
-            # their handles so waiters unblock instead of hanging.
+            # their handles so waiters unblock instead of hanging.  Typed
+            # HorovodInternalError (environmental, not a caller mistake)
+            # so elastic.run can recover by reinit + replay.
+            err = HorovodInternalError(f"control plane failed: {e}")
+            err.__cause__ = e
             for p in self._submitted.values():
                 self._end_negotiate(p)
-                self._mark_error(p.handle, e)
+                self._mark_error(p.handle, err)
             self._submitted.clear()
-            raise
+            raise err
         if self.timeline:
             for tname, trank in self.controller.drain_ticks():
                 self.timeline.instant(tname, f"NEGOTIATE_TICK_r{trank}")
@@ -602,7 +607,7 @@ class EagerEngine:
             # Orphaned ops (submitted but never matched before the shutdown
             # response) must error, not hang their waiters — parity with the
             # reference's SHUT_DOWN_ERROR callbacks (operations.cc:278-283).
-            err = RuntimeError(
+            err = HorovodInternalError(
                 "horovod_tpu has been shut down; collective was not "
                 "completed by all ranks"
             )
@@ -656,7 +661,7 @@ class EagerEngine:
                 if r is not None:
                     return r
                 if self._shutdown.is_set():
-                    raise RuntimeError(
+                    raise HorovodInternalError(
                         "engine shut down while waiting in hvd.join()"
                     )
                 time.sleep(max(self.config.cycle_time_ms, 0.5) / 1000.0)

@@ -13,6 +13,7 @@ import importlib.util
 import json
 import os
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -248,18 +249,25 @@ def test_run_worker_salvages_partial_line(bench, tmp_path, monkeypatch):
     def popen_fake(cmd, **kw):
         # Replace the real worker invocation with the wedge-after-primary
         # simulator; keep the orchestrator's plumbing (status file arg
-        # parsing, stdout pipe, kill path) fully real.
+        # parsing, stdout pipe, kill path) fully real.  -S skips the
+        # sitecustomize (axon plugin registration) the subprocess would
+        # otherwise import at startup, and the wait-for-status loop pins
+        # the orchestrator's t_spawn AFTER the checkpoint exists — the
+        # kill window is then deterministic no matter how loaded the box
+        # is (this test flaked twice on wall-clock startup latency).
         idx = cmd.index("--status-file")
-        return real_popen(
-            [sys.executable, "-c", fake_worker, "--status-file", cmd[idx + 1]],
-            **kw)
+        status_path = cmd[idx + 1]
+        proc = real_popen(
+            [sys.executable, "-S", "-c", fake_worker,
+             "--status-file", status_path], **kw)
+        deadline = time.time() + 60
+        while not os.path.exists(status_path) and time.time() < deadline:
+            time.sleep(0.05)
+        return proc
 
     monkeypatch.setattr(subprocess, "Popen", popen_fake)
-    # total_timeout must outlive interpreter startup under a loaded box
-    # (the full suite runs files in parallel with compile-heavy peers) but
-    # stay far below the fake worker's 120 s sleep.
     line, outcome = bench._run_worker("tpu", claim_timeout=30,
-                                      total_timeout=12)
+                                      total_timeout=4)
     assert outcome.startswith("ok (salvaged")
     assert line["value"] == 123.0
     assert "killed during stage 'llama'" in line["extras"]["salvaged"]

@@ -1,0 +1,194 @@
+"""hvd.elastic — State commit/restore/sync and the run() retry loop.
+
+The reference (Horovod 0.15.1) has no elastic mode; this mirrors the API
+Horovod grew in 0.20 (State/commit/restore + run decorator keyed on
+HorovodInternalError), reshaped for TPU gang semantics (durable rank-0
+commits; the launcher owns process supervision).  The gang-relaunch
+drill lives in tests/test_multiprocess.py (multiprocess_elastic_worker).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _mk_state(**kw):
+    return elastic.State(
+        params={"w": jnp.arange(4.0), "b": jnp.zeros(2)},
+        epoch=0, batch=0, **kw)
+
+
+def test_state_field_access_and_unknown_field():
+    s = _mk_state()
+    assert s.epoch == 0
+    s.epoch = 3
+    assert s.epoch == 3
+    assert np.allclose(np.asarray(s.params["w"]), np.arange(4.0))
+    with pytest.raises(AttributeError, match="unknown state field"):
+        s.momentum = 1.0          # not declared at construction
+    with pytest.raises(AttributeError):
+        _ = s.nope
+
+
+def test_state_requires_fields_and_rejects_reserved_names():
+    with pytest.raises(ValueError, match="at least one field"):
+        elastic.State()
+    with pytest.raises(ValueError, match="reserved"):
+        elastic.State(_private=1)
+
+
+def test_commit_restore_rolls_back_in_memory():
+    s = _mk_state()
+    s.epoch = 2
+    s.params = {"w": jnp.full(4, 7.0), "b": jnp.ones(2)}
+    s.commit()
+    assert s.commit_step == 1
+
+    s.epoch = 9                     # uncommitted divergence
+    s.params = {"w": jnp.zeros(4), "b": jnp.zeros(2)}
+    s.restore()
+    assert s.epoch == 2
+    assert s.commit_step == 1
+    assert np.allclose(np.asarray(s.params["w"]), 7.0)
+    # Scalar fields keep their Python types through the sync broadcast.
+    assert type(s.epoch) is int
+
+
+def test_restore_without_commit_syncs_initial_values():
+    s = _mk_state()
+    s.restore()                     # first-ever start: just a root sync
+    assert s.epoch == 0 and s.commit_step == 0
+    assert np.allclose(np.asarray(s.params["w"]), np.arange(4.0))
+
+
+def test_durable_commit_survives_a_fresh_state(tmp_path):
+    """The gang-relaunch path: a NEW process constructs State from initial
+    values and restore() adopts the newest durable commit."""
+    d = str(tmp_path / "ck")
+    s = _mk_state(ckpt_dir=d, sync_commits=True)
+    s.epoch, s.batch = 1, 5
+    s.commit()
+    s.batch = 6
+    s.commit()
+    hvd.wait_for_checkpoints()
+
+    fresh = _mk_state(ckpt_dir=d)   # initial values, same dir
+    fresh.restore()
+    assert (fresh.epoch, fresh.batch) == (1, 6)
+    assert fresh.commit_step == 2   # resumes the commit numbering
+
+
+def test_restore_walks_past_a_torn_checkpoint(tmp_path):
+    """A gang killed mid-write leaves a partial step_N dir; restore must
+    fall back to the previous good commit instead of failing the run."""
+    d = str(tmp_path / "ck")
+    s = _mk_state(ckpt_dir=d, sync_commits=True)
+    s.batch = 4
+    s.commit()
+    hvd.wait_for_checkpoints()
+    # Fabricate a newer, torn commit: the directory exists but holds
+    # nothing orbax can restore.
+    os.makedirs(os.path.join(d, "step_99"))
+
+    fresh = _mk_state(ckpt_dir=d)
+    fresh.restore()
+    assert fresh.batch == 4 and fresh.commit_step == 1
+
+
+def test_list_checkpoints_newest_first(tmp_path):
+    d = str(tmp_path / "ck")
+    s = _mk_state(ckpt_dir=d, sync_commits=True)
+    for _ in range(3):
+        s.commit()
+    hvd.wait_for_checkpoints()
+    got = hvd.latest_checkpoint(d)
+    assert got.endswith("step_3")
+    from horovod_tpu.checkpoint import list_checkpoints
+
+    names = [os.path.basename(p) for p in list_checkpoints(d)]
+    assert names == ["step_3", "step_2", "step_1"]
+
+
+def test_run_retries_internal_error_and_restores(monkeypatch):
+    """fn fails with HorovodInternalError twice; run() reinits, restores
+    the last commit, and replays — the uncommitted divergence made before
+    each crash must be rolled back."""
+    monkeypatch.setenv("HOROVOD_TPU_ELASTIC_RETRIES", "3")
+    s = _mk_state()
+    attempts = []
+
+    @elastic.run
+    def train(state):
+        attempts.append(state.batch)
+        if state.batch == 0:        # first entry: commit a known point
+            state.batch = 1
+            state.commit()
+        state.batch += 100          # uncommitted divergence
+        if len(attempts) < 3:
+            raise hvd.HorovodInternalError("synthetic collective failure")
+        return state.batch
+
+    out = train(s)
+    # Attempt 1 enters at batch 0; attempts 2 and 3 enter at the
+    # committed batch 1 (the +100 divergence rolled back each time).
+    assert attempts == [0, 1, 1]
+    assert out == 101
+    assert hvd.size() >= 1          # engine came back up after reinit
+
+
+def test_run_exhausts_retries(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TPU_ELASTIC_RETRIES", "1")
+    s = _mk_state()
+
+    @elastic.run
+    def always_fails(state):
+        raise hvd.HorovodInternalError("down forever")
+
+    with pytest.raises(hvd.HorovodInternalError, match="down forever"):
+        always_fails(s)
+
+
+def test_run_propagates_user_errors_without_retry():
+    s = _mk_state()
+    calls = []
+
+    @elastic.run
+    def buggy(state):
+        calls.append(1)
+        raise ValueError("a caller mistake, not environmental")
+
+    with pytest.raises(ValueError):
+        buggy(s)
+    assert calls == [1]             # no retry for deterministic errors
+
+
+def test_run_rejects_non_state_first_arg():
+    @elastic.run
+    def train(state):
+        return 1
+
+    with pytest.raises(TypeError, match="elastic.State"):
+        train({"params": 1})
+
+
+def test_engine_shutdown_raises_internal_error():
+    """The engine's environmental failures carry the typed exception
+    elastic keys on (enqueue after shutdown)."""
+    x = hvd.per_rank(lambda r: jnp.ones(2) * r)
+    hvd.shutdown()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.allreduce(x)
+    hvd.init()
+    assert issubclass(hvd.HorovodInternalError, RuntimeError)

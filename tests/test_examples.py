@@ -136,3 +136,23 @@ def test_pipeline_mlp_example():
         "pipeline_mlp.py",
         ["--stages", "4", "--microbatches", "4", "--steps", "12"],
     )
+
+
+def test_jax_elastic_example(tmp_path):
+    """The hvd.elastic example commits durably and a SECOND invocation
+    resumes from the final commit (epoch counter restored past the end,
+    so the loop body is skipped) instead of retraining."""
+    run_example(
+        "jax_elastic.py",
+        ["--epochs", "1", "--batch-per-chip", "4", "--samples", "256",
+         "--commit-every", "4", "--ckpt-dir", str(tmp_path)],
+    )
+    steps = [p for p in os.listdir(tmp_path) if p.startswith("step_")]
+    assert steps, os.listdir(tmp_path)
+    # Second run: restore() adopts epoch==1 (== --epochs), trains nothing,
+    # and exits cleanly — the gang-relaunch resume path in miniature.
+    run_example(
+        "jax_elastic.py",
+        ["--epochs", "1", "--batch-per-chip", "4", "--samples", "256",
+         "--commit-every", "4", "--ckpt-dir", str(tmp_path)],
+    )

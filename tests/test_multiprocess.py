@@ -503,3 +503,30 @@ def test_launcher_local_topology_four_process_single_host(tmp_path):
     )
     assert r.returncode == 0, (r.returncode, r.stdout[-4000:], r.stderr[-4000:])
     assert r.stdout.count("TOPO_OK") == 4, r.stdout[-4000:]
+
+
+@pytest.mark.slow
+def test_elastic_gang_relaunch_resumes(tmp_path):
+    """hvd.elastic end to end: durable sync commits every 2 batches, rank 1
+    killed at batch 5, launcher --restarts relaunches the gang, and the
+    relaunched run resumes from the batch-4 commit (asserted in-worker)
+    to the uninterrupted-run final value.  Capability the 0.15.1 reference
+    lacks (elastic arrived in Horovod 0.20; SURVEY §2.3)."""
+    env = dict(os.environ)
+    env.update(
+        HOROVOD_TPU_NATIVE_CONTROLLER="on",
+        ELASTIC_MARKER=str(tmp_path / "elastic.died"),
+        ELASTIC_CKPT=str(tmp_path / "elastic_ck"),
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+         "--cpu", "--restarts", "2", "--", sys.executable,
+         os.path.join(HERE, "multiprocess_elastic_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout[-4000:], r.stderr[-4000:])
+    assert "ELASTIC-KILL rank 1 dying mid-run" in r.stdout
+    assert "restarting (1/2)" in r.stderr, r.stderr[-2000:]
+    assert "ELASTIC-RESUMED batch=4" in r.stdout, r.stdout[-4000:]
+    assert r.stdout.count("ELASTIC_OK") == 2, r.stdout[-4000:]

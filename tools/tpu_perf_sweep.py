@@ -87,8 +87,11 @@ def result(name, **kv):
 def resnet_sweep():
     import horovod_tpu.models.resnet as resnet_mod
 
-    for bs in (64, 128, 256):
-        note(f"resnet101 bs{bs}: building")
+    # (bs, donate): the bs64 donate-off arm is the donated-buffers rung of
+    # the tuning ladder — same program minus donation, so the delta is
+    # pure allocation/HBM-pressure cost.
+    for bs, donate in ((64, True), (64, False), (128, True), (256, True)):
+        note(f"resnet101 bs{bs} donate={donate}: building")
         model = resnet_mod.ResNet101(dtype=jnp.bfloat16)
         kimg, klab = jax.random.split(jax.random.key(7))
         images = jax.random.normal(kimg, (bs, 224, 224, 3), jnp.float32)
@@ -107,19 +110,20 @@ def resnet_sweep():
 
         tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
         opt_state = jax.jit(tx.init)(params)
+        tag = f"resnet101_bs{bs}" + ("" if donate else "_nodonate")
         try:
             step, flops, out = _aot_compile(
-                hvd.make_train_step(loss_fn, tx, donate=True),
+                hvd.make_train_step(loss_fn, tx, donate=donate),
                 params, opt_state, (images, labels))
-            note(f"resnet101 bs{bs}: warm, timing")
+            note(f"{tag}: warm, timing")
             sps = time_steps(step, {"p": out.params, "o": out.opt_state},
                              (images, labels))
             mfu = _mfu(flops, sps)
-            result(f"resnet101_bs{bs}", img_per_sec=round(sps * bs, 1),
+            result(tag, img_per_sec=round(sps * bs, 1),
                    mfu=round(mfu, 4) if mfu is not None else None,
                    step_ms=round(1e3 / sps, 2))
         except Exception as exc:
-            result(f"resnet101_bs{bs}", error=f"{type(exc).__name__}: {exc}")
+            result(tag, error=f"{type(exc).__name__}: {exc}")
         _rearm()
 
 
