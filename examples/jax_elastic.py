@@ -71,6 +71,7 @@ def main():
             loader = ShardedLoader(
                 (images, labels), args.batch_per_chip, seed=state.epoch,
             )
+            out = None              # a resume may skip the whole epoch
             for i, batch in enumerate(loader):
                 if i < state.batch:
                     continue        # covered by the restored commit
@@ -79,7 +80,7 @@ def main():
                 state.batch = i + 1
                 if state.batch % args.commit_every == 0:
                     state.commit()
-            if hvd.rank() == 0:
+            if hvd.rank() == 0 and out is not None:
                 print(f"epoch {state.epoch}: loss {float(out.loss):.4f}",
                       flush=True)
             state.epoch += 1

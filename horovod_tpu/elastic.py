@@ -58,14 +58,29 @@ from horovod_tpu import basics, checkpoint
 from horovod_tpu.basics import HorovodInternalError
 from horovod_tpu.optim.distributed_optimizer import broadcast_optimizer_state
 
-__all__ = ["State", "run", "HorovodInternalError"]
+__all__ = ["BaseState", "State", "run", "HorovodInternalError"]
 
 # Key under which State stores its own bookkeeping inside the committed
 # tree (kept alongside user fields so one checkpoint is one commit).
 _META = "__elastic__"
 
 
-class State:
+class BaseState:
+    """The interface :func:`run` keys on — any state object exposing
+    commit / restore / sync (the JAX-native :class:`State` here, the
+    torch frontend's :class:`horovod_tpu.torch_elastic.TorchState`)."""
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class State(BaseState):
     """Named training state with commit / restore / sync semantics.
 
     ``fields`` are arbitrary pytrees (params, opt_state) or plain Python
@@ -240,18 +255,29 @@ def run(fn: Callable) -> Callable:
     (Horovod 0.20+)."""
 
     @functools.wraps(fn)
-    def wrapper(state: State, *args: Any, **kwargs: Any) -> Any:
-        if not isinstance(state, State):
+    def wrapper(state: BaseState, *args: Any, **kwargs: Any) -> Any:
+        if not isinstance(state, BaseState):
             raise TypeError("first argument to an elastic.run function "
-                            "must be an elastic.State")
+                            "must be an elastic.State (or TorchState)")
         basics._require_init()
         retries = int(os.environ.get("HOROVOD_TPU_ELASTIC_RETRIES", "3"))
         state.restore()
         attempt = 0
+        last_fail_commit: int | None = None
         while True:
             try:
                 return fn(state, *args, **kwargs)
             except HorovodInternalError:
+                # The budget bounds CONSECUTIVE unproductive failures, not
+                # lifetime failures: durable progress since the previous
+                # failure (commit_step advanced) resets it, so a long run
+                # survives any number of well-separated transient blips
+                # while a hard-down environment still exhausts quickly.
+                commit = getattr(state, "commit_step", None)
+                if (last_fail_commit is not None and commit is not None
+                        and commit > last_fail_commit):
+                    attempt = 0
+                last_fail_commit = commit
                 attempt += 1
                 if attempt > retries:
                     raise

@@ -822,3 +822,9 @@ def DistributedOptimizer(optimizer, named_parameters=None, *,
         optimizer, named_parameters, compression=compression, op=op,
         backward_passes_per_step=backward_passes_per_step,
     )
+
+
+# ----------------------------------------------------------------- elastic
+# hvd.elastic.TorchState / hvd.elastic.run — horovod.torch.elastic parity
+# (Horovod 0.20+; see horovod_tpu/torch_elastic.py).
+from horovod_tpu import torch_elastic as elastic  # noqa: E402,F401

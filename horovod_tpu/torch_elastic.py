@@ -1,0 +1,209 @@
+"""Elastic training for the torch frontend — ``horovod.torch.elastic``
+parity (Horovod 0.20+; the 0.15.1 reference has no elastic at all).
+
+``TorchState`` mirrors Horovod's: it tracks a torch ``model`` and/or
+``optimizer`` IN PLACE (restore loads state_dicts back into the live
+objects) plus named scalar progress fields, and plugs into the shared
+:func:`horovod_tpu.elastic.run` retry loop (reinit → restore → replay on
+:class:`~horovod_tpu.basics.HorovodInternalError`).
+
+Durability follows the torch-frontend conventions
+(examples/pytorch_imagenet_resnet50.py): rank 0 ``torch.save``s the
+state_dicts; a resume loads on root and fans out through
+``broadcast_parameters`` / ``broadcast_optimizer_state`` — non-root
+disks never need the checkpoint file.  Writes are atomic
+(tmp + ``os.replace``), so a gang killed mid-write leaves no torn
+``step_N.pt``; the restore walk still skips unreadable files for
+belt-and-braces.
+
+Usage::
+
+    import horovod_tpu.torch as hvd
+
+    state = hvd.elastic.TorchState(model=model, optimizer=optimizer,
+                                   ckpt_dir="/ckpts/run1", epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < epochs:
+            train_one_epoch(state.model, state.optimizer)
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+from typing import Any
+
+from horovod_tpu import elastic as _elastic
+from horovod_tpu.basics import HorovodInternalError  # noqa: F401 (re-export)
+
+__all__ = ["TorchState", "run", "HorovodInternalError"]
+
+run = _elastic.run          # the retry loop is frontend-agnostic
+BaseState = _elastic.BaseState
+
+
+def _hvdt():
+    # Function-level import: torch.py exposes this module as its
+    # ``elastic`` attribute, so a module-level import would be circular.
+    import horovod_tpu.torch as hvdt
+
+    return hvdt
+
+
+class TorchState(BaseState):
+    """Elastic state over live torch objects + scalar progress fields."""
+
+    def __init__(self, model: Any = None, optimizer: Any = None, *,
+                 ckpt_dir: str | None = None, **scalars: Any) -> None:
+        if model is None and optimizer is None and not scalars:
+            raise ValueError("TorchState needs a model, an optimizer, or "
+                             "at least one scalar field")
+        for k in scalars:
+            if k.startswith("_") or k in ("model", "optimizer"):
+                raise ValueError(f"reserved field name: {k!r}")
+        object.__setattr__(self, "model", model)
+        object.__setattr__(self, "optimizer", optimizer)
+        object.__setattr__(self, "_scalars", dict(scalars))
+        object.__setattr__(self, "_ckpt_dir",
+                           os.path.abspath(ckpt_dir) if ckpt_dir else None)
+        object.__setattr__(self, "_mem_commit", None)
+        object.__setattr__(self, "_commit_step", 0)
+
+    def __getattr__(self, name: str) -> Any:
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in ("model", "optimizer") or name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            scalars[name] = value
+        else:
+            raise AttributeError(
+                f"unknown state field {name!r}; declare every scalar in "
+                f"TorchState(...) so commits stay complete")
+
+    @property
+    def commit_step(self) -> int:
+        return object.__getattribute__(self, "_commit_step")
+
+    def _snapshot(self) -> dict:
+        return {
+            "model": (copy.deepcopy(self.model.state_dict())
+                      if self.model is not None else None),
+            "optimizer": (copy.deepcopy(self.optimizer.state_dict())
+                          if self.optimizer is not None else None),
+            "scalars": dict(object.__getattribute__(self, "_scalars")),
+            "commit_step": self.commit_step,
+        }
+
+    def commit(self) -> None:
+        """Snapshot in host memory; rank 0 additionally ``torch.save``s
+        ``step_N.pt`` atomically (tmp + rename — no torn files)."""
+        import torch
+
+        object.__setattr__(self, "_commit_step", self.commit_step + 1)
+        snap = self._snapshot()
+        object.__setattr__(self, "_mem_commit", snap)
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir and _hvdt().rank() == 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            dst = os.path.join(ckpt_dir, f"step_{self.commit_step}.pt")
+            torch.save(snap, dst + ".tmp")
+            os.replace(dst + ".tmp", dst)
+
+    def _load_local(self, snap: dict) -> None:
+        if self.model is not None and snap.get("model") is not None:
+            self.model.load_state_dict(snap["model"])
+        if self.optimizer is not None and snap.get("optimizer") is not None:
+            self.optimizer.load_state_dict(snap["optimizer"])
+        self._adopt_scalars(snap["scalars"])
+        object.__setattr__(self, "_commit_step",
+                           int(snap.get("commit_step", self.commit_step)))
+
+    def _adopt_scalars(self, incoming: dict) -> None:
+        # Only DECLARED fields are adopted (same contract as the JAX-side
+        # State._adopt): a commit from an older code revision must not
+        # inject undeclared keys past the __setattr__ completeness guard,
+        # nor silently leave a renamed field at its initial value without
+        # the reader noticing the mismatch in what restore() returns.
+        scalars = object.__getattribute__(self, "_scalars")
+        for k in scalars:
+            if k in incoming:
+                scalars[k] = incoming[k]
+
+    def sync(self) -> None:
+        """Fan the root's current state out to every rank (the reference
+        resume recipe, pytorch_imagenet_resnet50.py:134-142)."""
+        hvdt = _hvdt()
+        if self.model is not None:
+            hvdt.broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            hvdt.broadcast_optimizer_state(self.optimizer, root_rank=0)
+        agreed = hvdt.broadcast_object(
+            {"scalars": dict(object.__getattribute__(self, "_scalars")),
+             "commit_step": self.commit_step}, root_rank=0)
+        self._adopt_scalars(agreed["scalars"])
+        object.__setattr__(self, "_commit_step",
+                           int(agreed["commit_step"]))
+
+    def restore(self) -> None:
+        """Adopt the newest commit: durable ``step_N.pt`` (root reads,
+        everyone receives via sync) → in-memory snapshot → plain sync of
+        the initial values."""
+        import torch
+
+        hvdt = _hvdt()
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir:
+            snap = None
+            if hvdt.rank() == 0 and os.path.isdir(ckpt_dir):
+                steps = sorted(
+                    (int(m.group(1)) for m in (
+                        re.fullmatch(r"step_(\d+)\.pt", e)
+                        for e in os.listdir(ckpt_dir)) if m),
+                    reverse=True)
+                for s in steps:
+                    path = os.path.join(ckpt_dir, f"step_{s}.pt")
+                    try:
+                        snap = torch.load(path, map_location="cpu",
+                                          weights_only=False)
+                        break
+                    except Exception:
+                        continue      # unreadable/partial file: walk on
+            # Root LOADS BEFORE the agreement broadcast: a root-only
+            # load_state_dict failure (e.g. the relaunch runs changed
+            # model code) must fail every rank identically — if root
+            # loaded after the found-agreement, non-root ranks would
+            # already be blocked in sync()'s broadcast collective that
+            # root never enters (the hang checkpoint.py's
+            # restore_checkpoint guards against the same way).
+            outcome = None            # None = no commit; "ok"; or error str
+            if hvdt.rank() == 0 and snap is not None:
+                try:
+                    self._load_local(snap)
+                    outcome = "ok"
+                except Exception as e:
+                    outcome = f"{type(e).__name__}: {e}"
+            outcome = hvdt.broadcast_object(outcome, root_rank=0)
+            if outcome == "ok":
+                self.sync()           # root's loaded values fan out
+                return
+            if outcome is not None:
+                raise RuntimeError(
+                    f"elastic restore failed on root: {outcome}")
+        mem = object.__getattribute__(self, "_mem_commit")
+        if mem is not None:
+            self._load_local(mem)
+        self.sync()

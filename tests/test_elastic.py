@@ -184,9 +184,23 @@ def test_run_rejects_non_state_first_arg():
 
 
 def test_engine_shutdown_raises_internal_error():
-    """The engine's environmental failures carry the typed exception
-    elastic keys on (enqueue after shutdown)."""
+    """The enqueue-after-shutdown site (ops/eager.py) raises the TYPED
+    exception elastic.run keys on — exercised at the actual engine site,
+    not inferred from the subclass relationship: the engine's shutdown
+    flag is set underneath a live world (the race a gang teardown
+    creates) and the next enqueue must surface HorovodInternalError."""
+    from horovod_tpu.ops import eager as eager_mod
+
     x = hvd.per_rank(lambda r: jnp.ones(2) * r)
+    eng = eager_mod._engine()
+    eng._shutdown.set()             # shutdown races the caller's enqueue
+    try:
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.allreduce_async(x, name="el.shutdown.race")
+    finally:
+        hvd.shutdown()
+        hvd.init()                  # clean world for the suite
+    # After a FULL shutdown the basics layer rejects first (parity).
     hvd.shutdown()
     with pytest.raises(hvd.NotInitializedError):
         hvd.allreduce(x)

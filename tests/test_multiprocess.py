@@ -272,15 +272,17 @@ def test_launcher_restarts_rejected_multihost():
 
 
 @pytest.mark.slow
-def test_torch_adapter_two_processes():
+def test_torch_adapter_two_processes(tmp_path):
     """horovod_tpu.torch under the reference's exact process model: two OS
     processes, one CPU device each, torch tensors on the wire, hook-based
-    DistributedOptimizer keeping ranks identical."""
+    DistributedOptimizer keeping ranks identical (+ TorchState elastic
+    sync/restore fan-out across the real process boundary)."""
     outs = _run_workers(
         os.path.join(HERE, "multiprocess_torch_worker.py"), 2,
         {
             "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
             "HOROVOD_TPU_CONTROLLER_TRANSPORT": f"tcp:127.0.0.1:{_free_port()}",
+            "TORCH_ELASTIC_CKPT": str(tmp_path / "torch_el_ck"),
         },
     )
     for i, out in enumerate(outs):

@@ -1,0 +1,30 @@
+"""TorchState — hvd.elastic for the torch frontend (horovod.torch.elastic
+parity; Horovod 0.20+, absent from the 0.15.1 reference).
+
+The torch frontend mandates ONE device per process (torch.py init), and
+the suite conftest pins an 8-device mesh — so the state-machine scenarios
+run in a spawned 1-device worker (tests/torch_elastic_worker.py), the
+same pattern as every other torch-frontend test.  The engine retry loop
+is shared with the JAX-native State (tests/test_elastic.py).
+"""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_torch_elastic_state_machine():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "torch_elastic_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(HERE),
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout[-3000:],
+                               r.stderr[-3000:])
+    for marker in ("rollback ok", "durable ok", "api ok",
+                   "TORCH_ELASTIC_OK"):
+        assert marker in r.stdout, (marker, r.stdout[-3000:])

@@ -1,0 +1,116 @@
+"""Worker: TorchState state-machine scenarios under the torch
+frontend's one-device-per-process model (spawned by
+tests/test_torch_elastic.py with a 1-device CPU world)."""
+
+import os
+import sys
+import tempfile
+
+
+def _expect_raises(exc, match, fn):
+    try:
+        fn()
+    except exc as e:
+        assert match in str(e), (match, e)
+        return
+    raise AssertionError(f"expected {exc.__name__}({match!r})")
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import torch
+
+    import horovod_tpu.torch as hvdt
+
+    hvdt.init()
+
+    def model_and_opt():
+        torch.manual_seed(0)
+        m = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(m.parameters(), lr=0.1, momentum=0.9)
+        m(torch.randn(3, 4)).sum().backward()
+        opt.step()
+        return m, opt
+
+    # --- commit/restore rolls back model + optimizer + scalars
+    m, opt = model_and_opt()
+    st = hvdt.elastic.TorchState(model=m, optimizer=opt, epoch=0)
+    st.epoch = 1
+    st.commit()
+    committed = {k: v.clone() for k, v in m.state_dict().items()}
+    for _ in range(3):
+        m(torch.randn(3, 4)).sum().backward()
+        opt.step()
+    st.epoch = 7
+    assert not all(torch.equal(m.state_dict()[k], v)
+                   for k, v in committed.items())
+    st.restore()
+    assert st.epoch == 1 and st.commit_step == 1
+    for k, v in committed.items():
+        assert torch.equal(m.state_dict()[k], v), k
+    assert len(opt.state_dict()["state"]) > 0
+    print("rollback ok", flush=True)
+
+    # --- durable commit adopted by a fresh TorchState (gang relaunch)
+    with tempfile.TemporaryDirectory() as d:
+        m, opt = model_and_opt()
+        st = hvdt.elastic.TorchState(model=m, optimizer=opt,
+                                     ckpt_dir=d, epoch=0)
+        st.epoch = 2
+        st.commit()
+        want = {k: v.clone() for k, v in m.state_dict().items()}
+        m2, opt2 = model_and_opt()
+        for _ in range(2):
+            m2(torch.randn(3, 4)).sum().backward()
+            opt2.step()
+        fresh = hvdt.elastic.TorchState(model=m2, optimizer=opt2,
+                                        ckpt_dir=d, epoch=0)
+        fresh.restore()
+        assert fresh.epoch == 2 and fresh.commit_step == 1
+        for k, v in want.items():
+            assert torch.equal(m2.state_dict()[k], v), k
+        # torn/unreadable newest file: the walk falls back
+        with open(os.path.join(d, "step_99.pt"), "wb") as f:
+            f.write(b"not a torch file")
+        fresh2 = hvdt.elastic.TorchState(model=m2, optimizer=opt2,
+                                         ckpt_dir=d, epoch=0)
+        fresh2.restore()
+        assert fresh2.epoch == 2 and fresh2.commit_step == 1
+        # atomicity: no .tmp leftovers
+        assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    print("durable ok", flush=True)
+
+    # --- scalar fields, reserved names, run() acceptance
+    m, opt = model_and_opt()
+    st = hvdt.elastic.TorchState(model=m, epoch=0, best_acc=0.0)
+    st.best_acc = 0.5
+    assert st.best_acc == 0.5
+    _expect_raises(AttributeError, "unknown state field",
+                   lambda: setattr(st, "lr", 0.1))
+    _expect_raises(ValueError, "reserved",
+                   lambda: hvdt.elastic.TorchState(model=m, _x=1))
+    _expect_raises(ValueError, "needs a model",
+                   lambda: hvdt.elastic.TorchState())
+
+    st2 = hvdt.elastic.TorchState(model=m, optimizer=opt, epoch=0)
+
+    @hvdt.elastic.run
+    def train(state):
+        state.epoch += 1
+        return state.epoch
+
+    assert train(st2) == 1
+    print("api ok", flush=True)
+
+    hvdt.shutdown()
+    print("TORCH_ELASTIC_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
