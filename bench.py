@@ -833,6 +833,17 @@ def _worker_main(mode: str, status_path: str | None) -> None:
             extras["tunnel_rtt_ms"] = _measure_rtt_ms()
         except Exception as exc:
             extras["tunnel_rtt_ms_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            # HBM watermark after the primary arm: evidence the flagship
+            # config ran with headroom (vs silently paging/OOM-adjacent),
+            # and the denominator for batch-size-ceiling analysis in
+            # docs/perf-tuning.md.
+            mem = jax.local_devices()[0].memory_stats() or {}
+            for k in ("peak_bytes_in_use", "bytes_in_use", "bytes_limit"):
+                if k in mem:
+                    extras[f"hbm_{k}"] = int(mem[k])
+        except Exception:
+            pass            # memory_stats is optional per PJRT backend
     # A shrunken/forced rehearsal must be unmistakable in the artifact —
     # its numbers share keys with the flagship config and would otherwise
     # read as real in round-over-round comparison.

@@ -90,7 +90,9 @@ def main():
 
     train(state)
     hvd.wait_for_checkpoints()
-    hvd.shutdown()
+    # No explicit shutdown: the atexit hook owns teardown (repo example
+    # convention — an in-process caller, e.g. the example tests, keeps
+    # its session world).
 
 
 if __name__ == "__main__":

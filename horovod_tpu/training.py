@@ -79,6 +79,7 @@ def fit(
     train_loader,
     *,
     epochs: int = 1,
+    initial_epoch: int = 0,
     opt_state: Any = None,
     callbacks: Sequence[Callback] = (),
     eval_loader=None,
@@ -98,6 +99,11 @@ def fit(
       reference's broadcast of variables AND optimizer slots.
     * ``eval_metric_fn(params, batch) -> dict`` metrics are averaged over
       eval batches and merged into the epoch history.
+    * ``initial_epoch``: first epoch index to run (the Keras resume
+      parameter — reference examples/keras_imagenet_resnet50.py:171 passes
+      ``initial_epoch=resume_from_epoch`` after the rank-0 checkpoint
+      scan + broadcast); epoch-indexed callbacks (warmup/staircase
+      schedules) then see the true epoch number.
     """
     if opt_state is None:
         opt_state = optimizer.init(params)
@@ -109,7 +115,7 @@ def fit(
     params, opt_state = state
 
     history: list[dict] = []
-    for epoch in range(epochs):
+    for epoch in range(initial_epoch, epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         state = (params, opt_state)

@@ -36,3 +36,17 @@ def _hvd_world():
     hvd.init()
     yield
     hvd.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _ensure_world(_hvd_world):
+    """Re-init the full world if a prior test (or an in-process example
+    run — lifecycle tests, scaling/elastic examples) left it shut down or
+    on a device subset, so test outcomes never depend on file ordering
+    (r4 regression: an example's trailing shutdown() starved a later
+    module's world-size-8 assertions)."""
+    if not hvd.is_initialized() or hvd.size() != jax.device_count():
+        if hvd.is_initialized():
+            hvd.shutdown()
+        hvd.init()
+    yield

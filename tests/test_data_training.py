@@ -199,6 +199,32 @@ class TestFit:
         assert len(history) == 3
         assert history[-1]["loss"] < history[0]["loss"]
 
+    def test_fit_initial_epoch_resume(self):
+        """The Keras resume parameter (reference
+        keras_imagenet_resnet50.py:171 passes initial_epoch after the
+        rank-0 scan): only epochs [initial_epoch, epochs) run, and
+        epoch-indexed callbacks see the true epoch numbers."""
+        loss_fn, params, images, labels = self._setup()
+        seen: list[int] = []
+
+        class EpochSpy(hvd.Callback):
+            def on_epoch_begin(self, epoch, state):
+                seen.append(epoch)
+                return state
+
+        _, _, history = hvd.fit(
+            params,
+            hvd.DistributedOptimizer(optax.adam(0.05)),
+            loss_fn,
+            ShardedLoader((images, labels), 4),
+            epochs=5,
+            initial_epoch=3,
+            callbacks=[EpochSpy()],
+            verbose=False,
+        )
+        assert seen == [3, 4]
+        assert len(history) == 2
+
     def test_fit_eval_metrics(self):
         loss_fn, params, images, labels = self._setup()
 
