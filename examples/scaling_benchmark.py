@@ -39,6 +39,13 @@ def _build(model_name: str, on_tpu: bool, image_size: int):
         model = MLP()
         x = jnp.ones((1, 28 * 28), jnp.float32)
         classes = 10
+    elif model_name == "vit":
+        from horovod_tpu.models.vit import ViT_B16
+
+        model = ViT_B16(dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                        attn_impl="flash" if on_tpu else "dense")
+        x = jnp.ones((1, image_size, image_size, 3), jnp.float32)
+        classes = 1000
     elif model_name == "inception":
         from horovod_tpu.models.inception import InceptionV3
 
@@ -98,7 +105,7 @@ def _throughput(model, variables, in_shape, classes, batch_per_chip,
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "inception", "mlp"])
+                   choices=["resnet50", "inception", "vit", "mlp"])
     p.add_argument("--bs", type=int, default=None, help="batch per chip")
     p.add_argument("--img", type=int, default=None)
     p.add_argument("--iters", type=int, default=3)

@@ -5,5 +5,6 @@ from horovod_tpu.models.mnist import MnistConvNet, MnistMLP  # noqa: F401
 from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152  # noqa: F401
 from horovod_tpu.models.vgg import VGG16  # noqa: F401
 from horovod_tpu.models.inception import InceptionV3  # noqa: F401
+from horovod_tpu.models.vit import ViT, ViT_S16, ViT_B16, ViT_L16  # noqa: F401
 from horovod_tpu.models import llama  # noqa: F401
 from horovod_tpu.models import moe  # noqa: F401
