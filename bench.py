@@ -482,6 +482,79 @@ def _bench_resnet50(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_vit(hvd, on_tpu: bool) -> dict:
+    """ViT-B/16 training throughput (extras arm, TPU only): the
+    transformer-vision counterpart of the CNN arms — full train step
+    (patchify + 12 pre-LN blocks, dense attention at L=196, AdamW),
+    img/sec/chip and MFU.  Beyond-parity: the reference's zoo stops at
+    CNNs (no ViT anywhere in its tree)."""
+    if not on_tpu:
+        return {}
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        return _bench_vit_config(hvd, on_tpu, tiny=True)
+    return _bench_vit_config(hvd, on_tpu, tiny=False)
+
+
+def _bench_vit_config(hvd, on_tpu: bool, *, tiny: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.vit import ViT, ViT_B16
+
+    if tiny:                        # rehearsal: same code path, toy shape
+        model = ViT(patch=4, dim=32, depth=2, n_heads=2, num_classes=10,
+                    attn_impl="dense")
+        bs, img, iters, batches, label = 2, 16, 1, 2, "b2_img16_tiny"
+    else:
+        # Dense attention: at 224px/patch16 the sequence is 196 tokens,
+        # far below the ~2k-token crossover where the pallas flash kernel
+        # starts winning (flash 1.16x at L=2048, 2.41x at L=8192 on-chip,
+        # docs/artifacts/) - at L=196 XLA's fused dense attention is the
+        # faster choice.  attn_impl="flash" is for long-sequence ViTs
+        # (large images / small patches), not this config.
+        model = ViT_B16(dtype=jnp.bfloat16, attn_impl="dense")
+        bs = int(os.environ.get("HVD_TPU_BENCH_VIT_BS", "64"))
+        img, iters, batches, label = 224, 3, 10, f"b{bs}_img224"
+    n = hvd.size()
+    kimg, klab = jax.random.split(jax.random.key(23))
+    images = jax.random.normal(kimg, (bs * n, img, img, 3), jnp.float32)
+    labels = jax.random.randint(klab, (bs * n,), 0,
+                                model.num_classes, jnp.int32)
+    variables = jax.jit(model.init, static_argnames="train")(
+        jax.random.key(0), images[:1], train=False)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=True)
+        return optax.softmax_cross_entropy(
+            logits, jax.nn.one_hot(y, logits.shape[-1])).mean()
+
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-3))
+    params = variables["params"]
+    opt_state = jax.jit(tx.init)(params)
+    _set_stage("vit-step-compile", limit=_compile_stall_limit())
+    step, flops, out = _aot_compile(
+        hvd.make_train_step(loss_fn, tx, donate=on_tpu),
+        params, opt_state, (images, labels),
+    )
+    _set_stage("vit-timing")
+    state = {"p": out.params, "o": out.opt_state}
+
+    def one():
+        r = step(state["p"], state["o"], (images, labels))
+        state["p"], state["o"] = r.params, r.opt_state
+        return r.loss
+
+    sps = _time_loop(one, iters, batches)
+    mfu = _mfu(flops, sps)
+    return {
+        "vit_b16_images_per_sec_per_chip": round(sps * bs, 2),
+        "vit_b16_mfu": round(mfu, 4) if mfu is not None else None,
+        "vit_shape": label,
+    }
+
+
 def _bench_llama(hvd, on_tpu: bool, *, fused_loss: bool = False) -> dict:
     """Tokens/sec/chip + MFU on the flagship transformer (flash attention).
 
@@ -867,7 +940,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # then the llama arms earlier rounds recorded, then newer arms.
     for fn in (_bench_fusion, _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_resnet101_big_batch,
-               _bench_llama_decode):
+               _bench_llama_decode, _bench_vit):
         if time.monotonic() - _T_START > budget_s:
             extras.setdefault("skipped", []).append(fn.__name__)
             continue

@@ -41,6 +41,12 @@ class _Attention(nn.Module):
 
             # Bidirectional (causal=False): every patch attends to all.
             out = flash_attention(q, k, v, causal=False)
+        elif self.attn_impl != "dense":
+            # Same contract as models/llama.py: an unknown impl raises —
+            # a typo must not silently run dense attention.
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; "
+                f"expected 'dense' or 'flash'")
         else:
             scores = jnp.einsum(
                 "blhd,bmhd->bhlm", q, k

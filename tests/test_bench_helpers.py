@@ -271,3 +271,14 @@ def test_run_worker_salvages_partial_line(bench, tmp_path, monkeypatch):
     assert outcome.startswith("ok (salvaged")
     assert line["value"] == 123.0
     assert "killed during stage 'llama'" in line["extras"]["salvaged"]
+
+
+def test_vit_arm_rehearsal_path(bench, monkeypatch):
+    """The ViT extras arm's rehearsal config runs end-to-end on the CPU
+    stand-in and reports the labeled tiny shape."""
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HVD_TPU_BENCH_FORCE_TPU_PATHS", "1")
+    out = bench._bench_vit(hvd, True)
+    assert out["vit_b16_images_per_sec_per_chip"] > 0
+    assert out["vit_shape"] == "b2_img16_tiny"

@@ -444,3 +444,13 @@ def test_vit_trains_and_flash_matches_dense():
         params, opt_state = out.params, out.opt_state
         losses.append(float(out.loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_vit_unknown_attn_impl_raises():
+    from horovod_tpu.models.vit import ViT
+
+    m = ViT(patch=4, dim=32, depth=1, n_heads=2, num_classes=10,
+            attn_impl="Flash")          # typo'd case must not run dense
+    x = jnp.ones((1, 16, 16, 3))
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        m.init(jax.random.PRNGKey(0), x, train=False)
