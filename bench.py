@@ -490,19 +490,14 @@ def _bench_vit(hvd, on_tpu: bool) -> dict:
     CNNs (no ViT anywhere in its tree)."""
     if not on_tpu:
         return {}
-    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
-        return _bench_vit_config(hvd, on_tpu, tiny=True)
-    return _bench_vit_config(hvd, on_tpu, tiny=False)
-
-
-def _bench_vit_config(hvd, on_tpu: bool, *, tiny: bool) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
 
     from horovod_tpu.models.vit import ViT, ViT_B16
 
-    if tiny:                        # rehearsal: same code path, toy shape
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal: same code path, toy shape.
         model = ViT(patch=4, dim=32, depth=2, n_heads=2, num_classes=10,
                     attn_impl="dense")
         bs, img, iters, batches, label = 2, 16, 1, 2, "b2_img16_tiny"
