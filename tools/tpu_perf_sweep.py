@@ -30,7 +30,25 @@ def _rearm():
 
 _rearm()
 
+if (os.environ.get("SWEEP_ALLOW_CPU") == "1"
+        and "xla_force_host_platform_device_count" not in
+        os.environ.get("XLA_FLAGS", "")):
+    # The smoke is sized for the 8-device simulated mesh (bs/lbs = 8, one
+    # row per device) — without this flag a bare invocation would
+    # "validate" a degenerate 1-device world exercising no sharding at
+    # all.  Must land before jax import / first backend touch.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # The pool plugin's sitecustomize forces jax_platforms=axon,cpu at
+    # import, overriding the env var — a pinned-CPU smoke run would then
+    # hang dialing the tunnel.  An explicit config update wins (same
+    # trick as tests/conftest.py and the bench CPU worker).
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import optax
 
@@ -45,8 +63,10 @@ def note(msg):
 
 
 note(f"backend={jax.default_backend()} devices={jax.devices()}")
-if jax.default_backend() == "cpu":
-    sys.exit("needs the real chip; got cpu")
+_ON_TPU = jax.default_backend() != "cpu"
+if not _ON_TPU and os.environ.get("SWEEP_ALLOW_CPU") != "1":
+    sys.exit("needs the real chip; got cpu (SWEEP_ALLOW_CPU=1 runs a "
+             "shrunken smoke of every arm for rehearsal/verification)")
 
 # Share the bench's persistent compile cache so the sweep warms the real
 # run and vice versa (env-aware: HVD_TPU_BENCH_CACHE overrides).
@@ -61,12 +81,16 @@ import horovod_tpu as hvd
 hvd.init()
 
 
-def time_steps(step, state0, batch, iters=3, group=12):
+def time_steps(step, state0, batch, iters=None, group=None):
     """steps/sec over donation-chained groups, readback-fenced.
 
     Returns the BEST group (least interference) — a tuning signal, unlike
-    bench.py's mean-of-groups reporting number.
+    bench.py's mean-of-groups reporting number.  The CPU smoke shrinks to
+    one 2-step group (and re-arms the stall bound per group): smoke
+    validates the code path, not the numbers.
     """
+    iters = iters if iters is not None else (3 if _ON_TPU else 1)
+    group = group if group is not None else (12 if _ON_TPU else 2)
     state = state0
     rates = []
     for _ in range(iters):
@@ -76,6 +100,7 @@ def time_steps(step, state0, batch, iters=3, group=12):
             state = {"p": r.params, "o": r.opt_state, "loss": r.loss}
         _readback(state["loss"])
         rates.append(group / (time.perf_counter() - t))
+        _rearm()
     return max(rates)
 
 
@@ -90,11 +115,16 @@ def resnet_sweep():
     # (bs, donate): the bs64 donate-off arm is the donated-buffers rung of
     # the tuning ladder — same program minus donation, so the delta is
     # pure allocation/HBM-pressure cost.
-    for bs, donate in ((64, True), (64, False), (128, True), (256, True)):
+    # CPU smoke: one row per mesh device (the smoke runs on the 8-device
+    # simulation, where bs is the GLOBAL batch and must divide the mesh).
+    configs = ((64, True), (64, False), (128, True), (256, True)) \
+        if _ON_TPU else ((8, True),)
+    img = 224 if _ON_TPU else 32
+    for bs, donate in configs:
         note(f"resnet101 bs{bs} donate={donate}: building")
         model = resnet_mod.ResNet101(dtype=jnp.bfloat16)
         kimg, klab = jax.random.split(jax.random.key(7))
-        images = jax.random.normal(kimg, (bs, 224, 224, 3), jnp.float32)
+        images = jax.random.normal(kimg, (bs, img, img, 3), jnp.float32)
         labels = jax.random.randint(klab, (bs,), 0, 1000, jnp.int32)
         variables = jax.jit(model.init, static_argnames="train")(
             jax.random.key(0), images[:1], train=False)
@@ -131,7 +161,7 @@ def resnet_sweep():
 def flash_sweep():
     from horovod_tpu.parallel.flash_attention import flash_attention
 
-    B, L, H, KVH, D = 4, 2048, 16, 4, 64
+    B, L, H, KVH, D = (4, 2048, 16, 4, 64) if _ON_TPU else (1, 256, 2, 1, 64)
     ks = jax.random.split(jax.random.key(0), 3)
     q = jax.random.normal(ks[0], (B, L, H, D), jnp.bfloat16)
     k = jax.random.normal(ks[1], (B, L, KVH, D), jnp.bfloat16)
@@ -175,22 +205,26 @@ def flash_sweep():
 def llama_sweep():
     from horovod_tpu.models import llama
 
-    seq = 2048
+    seq = 2048 if _ON_TPU else 128
     for name, kw in (
         ("flash", dict(attn_impl="flash", remat=False)),
         ("flash_remat", dict(attn_impl="flash", remat=True)),
         ("dense", dict(attn_impl="dense", remat=False)),
     ):
         note(f"llama {name}: building")
-        cfg = llama.llama_tiny(
-            vocab_size=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
-            ffn_dim=4096, max_seq_len=seq, **kw)
+        if _ON_TPU:
+            cfg = llama.llama_tiny(
+                vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+                n_kv_heads=4, ffn_dim=4096, max_seq_len=seq, **kw)
+        else:
+            cfg = llama.llama_tiny(max_seq_len=seq, **kw)
         loss = llama.make_loss_fn(cfg)
         tx = hvd.DistributedOptimizer(optax.adamw(1e-4))
         params = llama.init_params(cfg, jax.random.key(0))
         opt_state = jax.jit(tx.init)(params)
+        lbs = 4 if _ON_TPU else 8       # smoke: divisible by the 8-mesh
         tokens = jax.random.randint(
-            jax.random.key(11), (4, seq), 0, cfg.vocab_size, jnp.int32)
+            jax.random.key(11), (lbs, seq), 0, cfg.vocab_size, jnp.int32)
         batch = (tokens, tokens)
         try:
             step, _flops, out = _aot_compile(
@@ -202,9 +236,9 @@ def llama_sweep():
             n_par = llama.num_params(cfg)
             # 6·N·D against the device-kind peak (same convention as
             # bench.py's llama_mfu_6nd).
-            mfu_6nd = _mfu(6.0 * n_par * 4 * seq, sps)
+            mfu_6nd = _mfu(6.0 * n_par * lbs * seq, sps)
             result(f"llama_{name}",
-                   tok_per_sec=round(sps * 4 * seq, 1),
+                   tok_per_sec=round(sps * lbs * seq, 1),
                    mfu_6nd=round(mfu_6nd, 4) if mfu_6nd is not None else None,
                    step_ms=round(1e3 / sps, 2))
         except Exception as exc:
@@ -212,12 +246,58 @@ def llama_sweep():
         _rearm()
 
 
+# ── ViT-B/16 batch sweep (transformer-vision MFU ladder) ─────────────────
+def vit_sweep():
+    from horovod_tpu.models.vit import ViT, ViT_B16
+
+    for bs in ((64, 128) if _ON_TPU else (8,)):
+        note(f"vit_b16 bs{bs}: building")
+        # Dense attention: 196 tokens is far below the flash kernel's
+        # ~2k-token crossover (bench.py _bench_vit).
+        model = (ViT_B16(dtype=jnp.bfloat16) if _ON_TPU
+                 else ViT(patch=8, dim=32, depth=2, n_heads=2,
+                          num_classes=10))
+        img = 224 if _ON_TPU else 32
+        kimg, klab = jax.random.split(jax.random.key(29))
+        images = jax.random.normal(kimg, (bs, img, img, 3), jnp.float32)
+        labels = jax.random.randint(klab, (bs,), 0, model.num_classes,
+                                    jnp.int32)
+        variables = jax.jit(model.init, static_argnames="train")(
+            jax.random.key(0), images[:1], train=False)
+
+        def loss_fn(params, batch):
+            x, y = batch
+            logits = model.apply({"params": params}, x, train=True)
+            return optax.softmax_cross_entropy(
+                logits, jax.nn.one_hot(y, logits.shape[-1])).mean()
+
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3))
+        params = variables["params"]
+        opt_state = jax.jit(tx.init)(params)
+        try:
+            step, flops, out = _aot_compile(
+                hvd.make_train_step(loss_fn, tx, donate=True),
+                params, opt_state, (images, labels))
+            note(f"vit_b16 bs{bs}: warm, timing")
+            sps = time_steps(step, {"p": out.params, "o": out.opt_state},
+                             (images, labels))
+            mfu = _mfu(flops, sps)
+            result(f"vit_b16_bs{bs}", img_per_sec=round(sps * bs, 1),
+                   mfu=round(mfu, 4) if mfu is not None else None,
+                   step_ms=round(1e3 / sps, 2))
+        except Exception as exc:
+            result(f"vit_b16_bs{bs}", error=f"{type(exc).__name__}: {exc}")
+        _rearm()
+
+
 if __name__ == "__main__":
-    which = os.environ.get("SWEEP", "resnet,flash,llama").split(",")
+    which = os.environ.get("SWEEP", "resnet,flash,llama,vit").split(",")
     if "resnet" in which:
         resnet_sweep()
     if "flash" in which:
         flash_sweep()
     if "llama" in which:
         llama_sweep()
+    if "vit" in which:
+        vit_sweep()
     note("sweep done")
