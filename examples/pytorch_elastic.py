@@ -1,0 +1,92 @@
+"""Fault-tolerant PyTorch MNIST with ``hvd.elastic.TorchState`` — the
+torch-frontend counterpart of examples/jax_elastic.py (Horovod grew this
+API in 0.20; the 0.15.1 reference has no elastic at all).
+
+The pattern, verbatim from horovod.elastic's torch docs reshaped for TPU
+gangs: declare the model/optimizer/progress in ``TorchState``, wrap the
+loop in ``@hvd.elastic.run`` (restores the newest durable commit on every
+(re)start), and commit on a cadence — advance-then-commit, so a restore
+never replays work the commit already covers.
+
+One process per device under the supervising launcher:
+
+    python -m horovod_tpu.launch --nproc 2 --cpu --restarts 3 -- \\
+        python examples/pytorch_elastic.py --epochs 4
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+from horovod_tpu.data import shard_indices, synthetic_mnist
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = torch.tanh(self.fc1(x.reshape(x.shape[0], -1)))
+        return self.fc2(x)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--samples", type=int, default=2048)
+    p.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_torch_elastic")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    model = Net()
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr * hvd.size(),
+                          momentum=0.5)
+    dist_opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                   ckpt_dir=args.ckpt_dir, epoch=0)
+
+    images, labels = synthetic_mnist(args.samples)
+    images = images.reshape(len(images), -1)
+
+    @hvd.elastic.run
+    def train(state):
+        # run() already restored the newest commit and synced every rank
+        # (covering the reference's broadcast_parameters +
+        # broadcast_optimizer_state preamble).
+        losses = []                 # a resume may cover every epoch
+        while state.epoch < args.epochs:
+            idx = shard_indices(len(images), hvd.rank(), hvd.size(),
+                                epoch=state.epoch, drop_last=True)
+            losses = []
+            for s in range(0, len(idx) - args.batch_size + 1,
+                           args.batch_size):
+                b = idx[s:s + args.batch_size]
+                x = torch.from_numpy(images[b])
+                y = torch.from_numpy(labels[b].astype(np.int64))
+                dist_opt.zero_grad()
+                loss = F.cross_entropy(state.model(x), y)
+                loss.backward()
+                dist_opt.step()
+                losses.append(float(loss.detach()))
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {np.mean(losses):.4f}",
+                      flush=True)
+            state.epoch += 1
+            state.commit()          # epoch boundary is durable
+        return float(np.mean(losses)) if losses else None
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -532,3 +532,32 @@ def test_elastic_gang_relaunch_resumes(tmp_path):
     assert "restarting (1/2)" in r.stderr, r.stderr[-2000:]
     assert "ELASTIC-RESUMED batch=4" in r.stdout, r.stdout[-4000:]
     assert r.stdout.count("ELASTIC_OK") == 2, r.stdout[-4000:]
+
+
+@pytest.mark.slow
+def test_pytorch_elastic_example_via_launcher(tmp_path):
+    """The torch-frontend elastic example: run once to completion, then
+    re-launch against the same commit dir — the second gang restores
+    epoch==epochs and trains nothing (resume-as-no-op, the gang-relaunch
+    path in miniature through TorchState)."""
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    cmd = [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+           "--cpu", "--restarts", "1", "--", sys.executable,
+           os.path.join(os.path.dirname(HERE), "examples",
+                        "pytorch_elastic.py"),
+           "--epochs", "1", "--samples", "256", "--batch-size", "16",
+           "--ckpt-dir", str(tmp_path / "ck")]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=300, cwd=os.path.dirname(HERE))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "epoch 0: loss" in r.stdout
+    assert (tmp_path / "ck" / "step_1.pt").exists()
+
+    r2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300, cwd=os.path.dirname(HERE))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "epoch 0: loss" not in r2.stdout     # resumed past the end
