@@ -78,7 +78,7 @@ def main():
                 loss.backward()
                 dist_opt.step()
                 losses.append(float(loss.detach()))
-            if hvd.rank() == 0:
+            if hvd.rank() == 0 and losses:
                 print(f"epoch {state.epoch}: loss {np.mean(losses):.4f}",
                       flush=True)
             state.epoch += 1
