@@ -1150,9 +1150,10 @@ def _preserved_window_artifact() -> dict | None:
         except OSError:
             return 0.0
 
+    here = os.path.dirname(os.path.abspath(__file__))
     pats = sorted(
-        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "docs", "artifacts", "BENCH_window_*.json")),
+        glob.glob(os.path.join(here, "docs", "artifacts",
+                               "BENCH_window_*.json")),
         key=_mtime,
     )
     for path in reversed(pats):     # newest usable wins
@@ -1161,10 +1162,36 @@ def _preserved_window_artifact() -> dict | None:
                 data = json.load(f)
             if data.get("extras", {}).get("backend") == "cpu":
                 continue           # a CPU artifact adds nothing here
-            data["artifact_path"] = os.path.relpath(
-                path, os.path.dirname(os.path.abspath(__file__))
-            )
+            data["artifact_path"] = os.path.relpath(path, here)
             return data
+        except Exception:
+            continue
+    # No full-bench window this round: the flash-check artifact (the
+    # claim probe doubles as an on-chip correctness + kernel-timing
+    # capture) is still same-round on-chip evidence — surface its
+    # verdict and flash-vs-dense speedups so the driver JSON carries
+    # the round's only hardware numbers.
+    import re as _re
+
+    flashes = sorted(
+        glob.glob(os.path.join(here, "docs", "artifacts",
+                               "window_flash_*.log")), key=_mtime)
+    for path in reversed(flashes):
+        try:
+            with open(path, errors="replace") as f:
+                text = f.read()
+            verdict = _re.search(r"CORRECTNESS: (\w+)", text)
+            if not verdict:
+                continue
+            speedups = _re.findall(
+                r"(seq \d+|fwd\+bwd per call).*?speedup ([\d.]+)x", text)
+            return {
+                "type": "flash_check_only",
+                "correctness": verdict.group(1),
+                "flash_vs_dense_speedups": {k: float(v)
+                                            for k, v in speedups},
+                "artifact_path": os.path.relpath(path, here),
+            }
         except Exception:
             continue
     return None
