@@ -26,5 +26,5 @@ def test_torch_elastic_state_machine():
     assert r.returncode == 0, (r.returncode, r.stdout[-3000:],
                                r.stderr[-3000:])
     for marker in ("rollback ok", "durable ok", "api ok",
-                   "TORCH_ELASTIC_OK"):
+                   "load-failure agreement ok", "TORCH_ELASTIC_OK"):
         assert marker in r.stdout, (marker, r.stdout[-3000:])

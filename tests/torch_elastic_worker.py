@@ -108,6 +108,20 @@ def main() -> None:
     assert train(st2) == 1
     print("api ok", flush=True)
 
+    # --- root-load-failure agreement: a durable commit from CHANGED model
+    # code must fail restore() with a clear error (on every rank, via the
+    # outcome broadcast) instead of stranding non-root ranks in the sync
+    # collective.
+    with tempfile.TemporaryDirectory() as d:
+        m_old = torch.nn.Linear(4, 2)
+        st_old = hvdt.elastic.TorchState(model=m_old, ckpt_dir=d, epoch=0)
+        st_old.commit()
+        m_new = torch.nn.Linear(8, 2)       # architecture changed
+        st_new = hvdt.elastic.TorchState(model=m_new, ckpt_dir=d, epoch=0)
+        _expect_raises(RuntimeError, "elastic restore failed on root",
+                       st_new.restore)
+    print("load-failure agreement ok", flush=True)
+
     hvdt.shutdown()
     print("TORCH_ELASTIC_OK", flush=True)
 
