@@ -721,6 +721,19 @@ def broadcast_object(obj, root_rank: int = 0):
     return _hvd.broadcast_object(obj, root_rank)
 
 
+def allgather_object(obj):
+    """One picklable object per process -> size()-long list ordered by
+    RANK (hvd.allgather_object, Horovod >=0.21).
+
+    The engine-level allgather_object orders by process index, but the
+    torch frontend's rank() is mesh-device order — and mesh order is not
+    guaranteed process-contiguous on multi-host pods.  Each entry is
+    therefore tagged with its sender's rank and the result re-sorted, so
+    ``out[hvd.rank()]`` is always this rank's object."""
+    tagged = _hvd.allgather_object((rank(), obj))
+    return [o for _, o in sorted(tagged, key=lambda t: t[0])]
+
+
 # --------------------------------------------------------------- optimizer
 
 

@@ -402,6 +402,11 @@ def main() -> None:
         assert torch.equal(em2.state_dict()["weight"],
                            em.state_dict()["weight"])
 
+    # --- allgather_object (Horovod >=0.21): one object per rank, ordered.
+    objs = hvd.allgather_object({"rank": me, "tag": f"obj{me}"})
+    assert [o["rank"] for o in objs] == list(range(n)), objs
+    assert objs[me]["tag"] == f"obj{me}"
+
     hvd.shutdown()
     print("TORCH_OK " + json.dumps({"rank": me, "size": n}), flush=True)
 
