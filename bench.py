@@ -26,8 +26,11 @@ Beyond the reference's img/sec, the primary line carries TPU-first metrics:
 * ``extras.llama_fused_loss_*`` — the chunked fused linear+cross-entropy
   A/B; ``extras.resnet101_bs128_*`` — MFU-ceiling probe beyond the
   reference's bs-64 config; ``extras.generate_*`` — end-to-end KV-cache
-  generation throughput; ``extras.tunnel_rtt_ms`` — the relay's measured
-  round-trip floor (see "Reading MFU" in docs/benchmarks.md).
+  generation throughput; ``extras.vit_b16_*`` — ViT-B/16 train step
+  (dense attention at L=196; the flash crossover is ~2k tokens);
+  ``extras.hbm_*`` — device memory watermark after the primary arm;
+  ``extras.tunnel_rtt_ms`` — the relay's measured round-trip floor (see
+  "Reading MFU" in docs/benchmarks.md).
 
 TPU bring-up — orchestrator/worker split
 ----------------------------------------
