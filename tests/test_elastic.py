@@ -115,9 +115,8 @@ def test_list_checkpoints_newest_first(tmp_path):
     hvd.wait_for_checkpoints()
     got = hvd.latest_checkpoint(d)
     assert got.endswith("step_3")
-    from horovod_tpu.checkpoint import list_checkpoints
-
-    names = [os.path.basename(p) for p in list_checkpoints(d)]
+    # Package export (docs/api.md lists it beside latest/restore).
+    names = [os.path.basename(p) for p in hvd.list_checkpoints(d)]
     assert names == ["step_3", "step_2", "step_1"]
 
 
