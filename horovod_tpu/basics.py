@@ -97,6 +97,12 @@ class _State:
         self.profiler_active = False  # start_timeline(profiler_dir=...)
         # (local_rank, local_size) — resolved lazily, cached per init()
         self.local_topology: tuple[int, int] | None = None
+        # The (devices, mesh) arguments of the last successful init(),
+        # kept through shutdown() so an elastic in-process retry can
+        # replay the SAME world: a bare re-init() would silently widen a
+        # device-subset/custom-mesh world to all devices, changing
+        # size() and the rank mapping mid-training.
+        self.last_init_args: tuple | None = None
 
 
 _state = _State()
@@ -304,6 +310,13 @@ def init(
             _state.mesh = Mesh(np.asarray(devs), (AXIS_NAME,))
         _state.config = EngineConfig.from_env()
         _state.local_topology = None
+        if mesh is not None:
+            _state.last_init_args = (None, mesh)
+        else:
+            # Record the MATERIALIZED list, not the caller's argument: a
+            # one-shot iterable is already exhausted by the list() above.
+            _state.last_init_args = (
+                tuple(devs) if devices is not None else None, None)
         _post_host_card(_state)
         _state.initialized = True
         _state.shut_down = False

@@ -50,9 +50,11 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 from typing import Any, Callable
 
 import jax
+import numpy as np
 
 from horovod_tpu import basics, checkpoint
 from horovod_tpu.basics import HorovodInternalError
@@ -63,6 +65,19 @@ __all__ = ["BaseState", "State", "run", "HorovodInternalError"]
 # Key under which State stores its own bookkeeping inside the committed
 # tree (kept alongside user fields so one checkpoint is one commit).
 _META = "__elastic__"
+
+
+def _own(leaf: Any) -> Any:
+    """A mutable, un-aliased copy of a numpy leaf.
+
+    Durable restores hand back READ-ONLY numpy arrays, and adopting an
+    array by reference would alias live state to the commit snapshot —
+    a later in-place mutation of the field would silently corrupt the
+    rollback point.  Every numpy leaf that crosses the snapshot/live
+    boundary goes through here."""
+    if isinstance(leaf, np.ndarray):
+        return np.array(leaf)
+    return leaf
 
 
 class BaseState:
@@ -148,7 +163,17 @@ class State(BaseState):
         (checkpoint.save_checkpoint); call sparingly — everything since
         the last commit is redone after a failure."""
         object.__setattr__(self, "_commit_step", self.commit_step + 1)
-        snap = jax.device_get(self._tree())
+        live = self._tree()
+        # device_get passes plain numpy leaves through unchanged (and
+        # hands back memory-sharing views for ndarray subclasses like
+        # np.memmap) — without the un-aliasing copy the snapshot would
+        # share storage with the live field, and an in-place mutation
+        # after commit() would corrupt the rollback point.
+        snap = jax.tree.map(
+            lambda l, s: np.array(s)
+            if (isinstance(s, np.ndarray) and isinstance(l, np.ndarray)
+                and np.shares_memory(s, l)) else s,
+            live, jax.device_get(live))
         object.__setattr__(self, "_mem_commit", snap)
         ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
         if ckpt_dir:
@@ -216,10 +241,13 @@ class State(BaseState):
             # scalars — `state.epoch += 1` would then die on "output
             # array is read-only".  Leaves declared as plain scalars are
             # cast back to their declared type (same restoration
-            # broadcast_optimizer_state does after its wire trip).
+            # broadcast_optimizer_state does after its wire trip);
+            # numpy leaves come back as writable, un-aliased copies
+            # (_own) so a field declared as a numpy buffer can be
+            # mutated in place without corrupting the snapshot.
             if isinstance(cur, (bool, int, float)):
                 return type(cur)(new)
-            return new
+            return _own(new)
 
         for k in fields:
             if k in tree:
@@ -227,20 +255,39 @@ class State(BaseState):
                     fields[k] = jax.tree.map(_coerce, fields[k], tree[k])
                 except (ValueError, TypeError):
                     # Structure drift (a field re-shaped between runs):
-                    # adopt verbatim rather than refusing the commit.
-                    fields[k] = tree[k]
+                    # adopt rather than refusing the commit — but say so
+                    # (a silent adoption masks genuine commit/code
+                    # mismatches), and still make the adopted leaves
+                    # mutable: durable restores hand back READ-ONLY
+                    # numpy arrays, the same failure _coerce prevents on
+                    # the matched path.
+                    warnings.warn(
+                        f"elastic state field {k!r}: restored structure "
+                        f"does not match the declared field; adopting the "
+                        f"restored value as-is (check for model/optimizer "
+                        f"code drift between commit and restore)",
+                        stacklevel=2)
+                    fields[k] = jax.tree.map(_own, tree[k])
 
 
 def _reinit() -> None:
     """Tear the engine down (tolerating an already-dead one) and bring it
-    back up for the retry."""
+    back up for the retry — replaying the ORIGINAL ``init()`` arguments.
+
+    A bare ``init()`` here would silently re-initialize a
+    device-subset/custom-mesh world over ALL devices: ``hvd.size()``, the
+    rank mapping, and data sharding would change mid-training with no
+    error.  ``basics`` records the last init arguments (surviving
+    ``shutdown()``) precisely so this replay reconstructs the same world.
+    """
     import horovod_tpu as hvd
 
+    devices, mesh_arg = basics._state.last_init_args or (None, None)
     try:
         hvd.shutdown()
     except Exception:
         pass
-    hvd.init()
+    hvd.init(devices=devices, mesh=mesh_arg)
 
 
 def run(fn: Callable) -> Callable:
@@ -261,11 +308,19 @@ def run(fn: Callable) -> Callable:
                             "must be an elastic.State (or TorchState)")
         basics._require_init()
         retries = int(os.environ.get("HOROVOD_TPU_ELASTIC_RETRIES", "3"))
-        state.restore()
         attempt = 0
         last_fail_commit: int | None = None
+        need_restore = True
         while True:
             try:
+                # restore() performs collectives (broadcast in sync /
+                # restore_checkpoint) and can itself raise an
+                # environmental HorovodInternalError — it lives INSIDE
+                # the retried region so such a failure consumes an
+                # attempt rather than aborting the elastic loop.
+                if need_restore:
+                    state.restore()
+                    need_restore = False
                 return fn(state, *args, **kwargs)
             except HorovodInternalError:
                 # The budget bounds CONSECUTIVE unproductive failures, not
@@ -282,6 +337,6 @@ def run(fn: Callable) -> Callable:
                 if attempt > retries:
                     raise
                 _reinit()
-                state.restore()
+                need_restore = True
 
     return wrapper

@@ -37,7 +37,10 @@ from __future__ import annotations
 
 import copy
 import os
+import pickle
 import re
+import warnings
+import zipfile
 from typing import Any
 
 from horovod_tpu import elastic as _elastic
@@ -120,7 +123,15 @@ class TorchState(BaseState):
         if ckpt_dir and _hvdt().rank() == 0:
             os.makedirs(ckpt_dir, exist_ok=True)
             dst = os.path.join(ckpt_dir, f"step_{self.commit_step}.pt")
-            torch.save(snap, dst + ".tmp")
+            # fsync BEFORE the rename: without it a power loss can
+            # persist the rename while payload blocks are still zeroed —
+            # a structurally-valid-but-corrupt file the restore walk's
+            # is_zipfile torn-write discrimination would then hard-fail
+            # on.  With the fsync, a renamed file is a complete file.
+            with open(dst + ".tmp", "wb") as f:
+                torch.save(snap, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(dst + ".tmp", dst)
 
     def _load_local(self, snap: dict) -> None:
@@ -167,33 +178,58 @@ class TorchState(BaseState):
         hvdt = _hvdt()
         ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
         if ckpt_dir:
-            snap = None
-            if hvdt.rank() == 0 and os.path.isdir(ckpt_dir):
-                steps = sorted(
-                    (int(m.group(1)) for m in (
-                        re.fullmatch(r"step_(\d+)\.pt", e)
-                        for e in os.listdir(ckpt_dir)) if m),
-                    reverse=True)
-                for s in steps:
-                    path = os.path.join(ckpt_dir, f"step_{s}.pt")
-                    try:
-                        snap = torch.load(path, map_location="cpu",
-                                          weights_only=False)
-                        break
-                    except Exception:
-                        continue      # unreadable/partial file: walk on
-            # Root LOADS BEFORE the agreement broadcast: a root-only
-            # load_state_dict failure (e.g. the relaunch runs changed
-            # model code) must fail every rank identically — if root
-            # loaded after the found-agreement, non-root ranks would
-            # already be blocked in sync()'s broadcast collective that
-            # root never enters (the hang checkpoint.py's
+            # EVERY root-side failure — walking the dir, loading a file,
+            # applying the state_dicts — is converted to an outcome value
+            # and agreed via the broadcast below.  Root must reach that
+            # broadcast no matter what: non-root ranks enter it
+            # unconditionally, so a root-only raise here would strand
+            # them in the collective forever (the hang checkpoint.py's
             # restore_checkpoint guards against the same way).
             outcome = None            # None = no commit; "ok"; or error str
-            if hvdt.rank() == 0 and snap is not None:
+            if hvdt.rank() == 0:
                 try:
-                    self._load_local(snap)
-                    outcome = "ok"
+                    snap = None
+                    if os.path.isdir(ckpt_dir):
+                        steps = sorted(
+                            (int(m.group(1)) for m in (
+                                re.fullmatch(r"step_(\d+)\.pt", e)
+                                for e in os.listdir(ckpt_dir)) if m),
+                            reverse=True)
+                        for s in steps:
+                            path = os.path.join(ckpt_dir, f"step_{s}.pt")
+                            try:
+                                snap = torch.load(path, map_location="cpu",
+                                                  weights_only=False)
+                                break
+                            except (RuntimeError, EOFError,
+                                    zipfile.BadZipFile,
+                                    pickle.UnpicklingError) as e:
+                                # torch.load also raises RuntimeError for
+                                # ENVIRONMENTAL failures (OOM, mmap).  A
+                                # torn write never survives the zip
+                                # end-of-central-directory check, so a
+                                # structurally intact file means the
+                                # error is not truncation: fail every
+                                # rank via the outcome broadcast rather
+                                # than silently rolling back to an older
+                                # commit.
+                                if (isinstance(e, RuntimeError)
+                                        and zipfile.is_zipfile(path)):
+                                    raise
+                                # A torn/corrupt file from a mid-write
+                                # kill: walk on to the previous commit —
+                                # LOUDLY, because later commits renumber
+                                # over the skipped step.
+                                warnings.warn(
+                                    f"elastic restore: skipping "
+                                    f"unreadable checkpoint {path} "
+                                    f"({type(e).__name__}: {e}); falling "
+                                    f"back to the previous commit",
+                                    stacklevel=2)
+                                continue
+                    if snap is not None:
+                        self._load_local(snap)
+                        outcome = "ok"
                 except Exception as e:
                     outcome = f"{type(e).__name__}: {e}"
             outcome = hvdt.broadcast_object(outcome, root_rank=0)

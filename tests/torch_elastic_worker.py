@@ -80,8 +80,28 @@ def main() -> None:
             f.write(b"not a torch file")
         fresh2 = hvdt.elastic.TorchState(model=m2, optimizer=opt2,
                                          ckpt_dir=d, epoch=0)
-        fresh2.restore()
+        import warnings as _w
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            fresh2.restore()
+        # The rollback to an older commit is VISIBLE (ADVICE r4): the walk
+        # names the skipped file and why.
+        assert any("skipping unreadable checkpoint" in str(x.message)
+                   for x in rec), [str(x.message) for x in rec]
         assert fresh2.epoch == 2 and fresh2.commit_step == 1
+        # A structurally-VALID zip with foreign content is not a torn
+        # write: restore must fail every rank via the outcome broadcast,
+        # not silently roll back past committed progress.
+        import zipfile as _zf
+
+        with _zf.ZipFile(os.path.join(d, "step_100.pt"), "w") as z:
+            z.writestr("data", "not a checkpoint")
+        fresh3 = hvdt.elastic.TorchState(model=m2, optimizer=opt2,
+                                         ckpt_dir=d, epoch=0)
+        _expect_raises(RuntimeError, "elastic restore failed on root",
+                       fresh3.restore)
+        os.remove(os.path.join(d, "step_100.pt"))
         # atomicity: no .tmp leftovers
         assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
     print("durable ok", flush=True)
