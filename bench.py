@@ -822,11 +822,18 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # 10-40 s; cached executables survive across worker processes (and
     # across the round's rehearsals vs the driver's real run on the same
     # host), so a cache hit buys the budget fence whole extra arms.
+    # The CPU worker keeps the cache DELIBERATELY (allow_cpu_aot): its
+    # fallback reserve depends on warm compiles, same-host XLA:CPU AOT
+    # reloads are noisy-but-functional, and cross-host loads are guarded
+    # by the host-fingerprint subdir.  The dryrun/driver paths refuse it
+    # instead (see enable_persistent_compile_cache).
     from horovod_tpu.utils.env import enable_persistent_compile_cache
 
     enable_persistent_compile_cache(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+                     ".jax_cache"),
+        platform=("cpu" if mode == "cpu" else None),
+        allow_cpu_aot=(mode == "cpu"))
 
     if mode == "cpu":
         # The env var alone is NOT enough: a pool plugin's sitecustomize

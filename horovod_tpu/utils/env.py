@@ -157,7 +157,10 @@ class EngineConfig:
         )
 
 
-def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
+def enable_persistent_compile_cache(
+    default_dir: str | None = None, platform: str | None = None,
+    allow_cpu_aot: bool = False,
+) -> None:
     """Point jax's persistent compilation cache at ``HVD_TPU_BENCH_CACHE``
     (or ``default_dir``) so compile work survives across processes — the
     bench orchestrator's workers, rehearsals, the driver's entry-point
@@ -168,13 +171,57 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
     without the knob (or a read-only path) degrades to per-process
     compiles with a one-line ``RuntimeWarning`` breadcrumb — callers never
     depend on the cache for correctness.
+
+    ``platform`` is the backend this process is pinned to, when the
+    caller knows it; ``None`` reads the pin from
+    ``jax.config.jax_platforms`` (set by the test conftest, the dryrun's
+    CPU-mesh forcing, and the bench CPU worker).  **A CPU pin refuses the
+    cache** — and actively clears any cache dir enabled earlier in the
+    process: XLA:CPU serialized executables are AOT blobs whose
+    compile-feature list includes XLA-injected pseudo-features
+    (``+prefer-no-gather``/``+prefer-no-scatter``) that the loader's host
+    feature check can NEVER match, so every reload — even same-host,
+    same-process — logs "could lead to execution errors such as SIGILL",
+    and a cross-host load can actually SIGILL (observed as the
+    MULTICHIP_r04 error wall).  TPU executables have no such loader, so
+    the cache stays on where it pays (window compile reuse).
+
+    ``allow_cpu_aot=True`` overrides the refusal for callers that accept
+    the same-host loader noise in exchange for warm compiles (the bench
+    CPU-fallback worker, whose time reserve depends on them; cross-host
+    loads stay guarded by the host-fingerprint subdir).  Residual gap,
+    accepted: a process with NO platform pin that happens to resolve to
+    the CPU backend (e.g. a manual sweep smoke on a TPU-less host) still
+    enables the cache — refusing on an unknown platform would disable
+    the cache for every TPU claim (the ambient env is unpinned exactly
+    there), and probing the backend here could hang on a down tunnel.
     """
+    try:
+        import jax
+
+        if platform is None:
+            try:
+                raw = jax.config.jax_platforms or ""
+                platform = raw.split(",")[0].strip() or None
+            except Exception:
+                platform = None
+        if platform == "cpu" and not allow_cpu_aot:
+            # The refusal does not depend on a cache path being
+            # configured: clear any dir enabled earlier in the process
+            # (the entry()-then-dryrun single-process flow).
+            try:
+                jax.config.update("jax_compilation_cache_dir", None)
+            except Exception:
+                pass
+            return
+    except Exception:
+        pass
     path = os.environ.get("HVD_TPU_BENCH_CACHE") or default_dir
     if not path:
         return
     try:
         import hashlib
-        import platform
+        import platform as platform_mod
 
         import jax
 
@@ -193,10 +240,10 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
             flags = next(
                 (ln for ln in cpu.splitlines()
                  if ln.startswith(("flags", "Features"))),
-                platform.processor() or cpu[:512],
+                platform_mod.processor() or cpu[:512],
             )
         except OSError:
-            flags = platform.processor() or platform.platform()
+            flags = platform_mod.processor() or platform_mod.platform()
         # jaxlib in the key too: XLA injects target features beyond
         # cpuinfo's (+prefer-no-scatter/gather and friends) that change
         # across jaxlib builds — an AOT blob from another jaxlib on the
@@ -206,7 +253,7 @@ def enable_persistent_compile_cache(default_dir: str | None = None) -> None:
 
         jl = getattr(jaxlib, "__version__", "?")
         host_key = hashlib.sha1(
-            (platform.machine() + ":" + jl + ":" + flags).encode()
+            (platform_mod.machine() + ":" + jl + ":" + flags).encode()
         ).hexdigest()[:10]
         jax.config.update(
             "jax_compilation_cache_dir", os.path.join(path, host_key))

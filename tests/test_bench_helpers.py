@@ -111,7 +111,11 @@ def test_enable_persistent_compile_cache_env_override(tmp_path, monkeypatch):
     try:
         override = str(tmp_path / "override_cache")
         monkeypatch.setenv("HVD_TPU_BENCH_CACHE", override)
-        enable_persistent_compile_cache(str(tmp_path / "default_cache"))
+        # platform="tpu": the suite runs under a CPU pin, which refuses
+        # the cache (see test below); the enable path needs an
+        # accelerator platform.
+        enable_persistent_compile_cache(str(tmp_path / "default_cache"),
+                                        platform="tpu")
         # The helper appends a host-fingerprint subdir (AOT blobs bake in
         # machine features; a foreign host's blobs could SIGILL).
         assert jax.config.jax_compilation_cache_dir.startswith(override)
@@ -119,19 +123,57 @@ def test_enable_persistent_compile_cache_env_override(tmp_path, monkeypatch):
 
         monkeypatch.delenv("HVD_TPU_BENCH_CACHE")
         default = str(tmp_path / "default_cache")
-        enable_persistent_compile_cache(default)
+        enable_persistent_compile_cache(default, platform="tpu")
         assert jax.config.jax_compilation_cache_dir.startswith(default)
         got_default = jax.config.jax_compilation_cache_dir
         # Same host fingerprint under both roots.
         assert (os.path.basename(got_override)
                 == os.path.basename(got_default))
 
-        # No env, no default: a no-op, not a crash (and config unchanged).
-        enable_persistent_compile_cache(None)
+        # No env, no default: a no-op, not a crash (and config
+        # unchanged).  platform="tpu" again — under the suite's CPU pin
+        # the refusal path would legitimately CLEAR the dir first.
+        enable_persistent_compile_cache(None, platform="tpu")
         assert jax.config.jax_compilation_cache_dir == got_default
     finally:
         # The config is process-global: restore so later suite compiles
         # don't write into this test's deleted tmp dir.
+        jax.config.update("jax_compilation_cache_dir", orig)
+
+
+def test_compile_cache_refused_on_cpu(tmp_path, monkeypatch):
+    """A CPU pin must refuse the persistent cache AND clear one enabled
+    earlier in the process: XLA:CPU AOT blobs carry XLA-injected
+    +prefer-no-* compile features the loader's host check can never
+    match, so every reload logs a SIGILL-risk error (MULTICHIP_r04) and
+    a cross-host load can actually SIGILL."""
+    from horovod_tpu.utils.env import enable_persistent_compile_cache
+
+    orig = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setenv("HVD_TPU_BENCH_CACHE", str(tmp_path / "c"))
+        # Explicit CPU pin refuses.
+        jax.config.update("jax_compilation_cache_dir", None)
+        enable_persistent_compile_cache(platform="cpu")
+        assert jax.config.jax_compilation_cache_dir is None
+        # Inferred pin (the suite conftest pins jax_platforms=cpu —
+        # exactly what dryrun_multichip's CPU-mesh forcing does) refuses
+        # too.
+        assert jax.config.jax_platforms.split(",")[0] == "cpu"
+        enable_persistent_compile_cache()
+        assert jax.config.jax_compilation_cache_dir is None
+        # And it actively CLEARS a cache dir enabled before the pin was
+        # known (the __main__ flow: entry() then dryrun in one process) —
+        # even when no cache path is configured at all.
+        monkeypatch.delenv("HVD_TPU_BENCH_CACHE")
+        jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+        enable_persistent_compile_cache(platform="cpu")
+        assert jax.config.jax_compilation_cache_dir is None
+        # The bench CPU-fallback worker's explicit opt-in still enables.
+        monkeypatch.setenv("HVD_TPU_BENCH_CACHE", str(tmp_path / "c"))
+        enable_persistent_compile_cache(platform="cpu", allow_cpu_aot=True)
+        assert jax.config.jax_compilation_cache_dir is not None
+    finally:
         jax.config.update("jax_compilation_cache_dir", orig)
 
 
