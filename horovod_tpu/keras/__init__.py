@@ -1,0 +1,412 @@
+"""``import horovod_tpu.keras as hvd`` — the reference's Keras frontend,
+re-targeted at Keras 3 on the JAX backend.
+
+Parity surface (reference horovod/keras/__init__.py:1-148 and
+horovod/_keras/__init__.py): ``init/shutdown/size/local_size/rank/
+local_rank``, ``DistributedOptimizer``, ``broadcast_global_variables``,
+value-level ``allreduce/allgather/broadcast``, ``load_model`` (optimizer
+re-wrapped at deserialization so its slot state survives), and the four
+callbacks in :mod:`horovod_tpu.keras.callbacks`.
+
+TPU-native design — two regimes, same surface:
+
+* **Multi-process** (one process per chip, the reference's process model,
+  ``jax.process_count() > 1``): gradients are averaged through the eager
+  engine — the same native-controller negotiation + fused XLA collectives
+  the torch frontend uses.  Inside Keras's jitted train step the allreduce
+  rides ``jax.experimental.io_callback`` (ordered), exactly where the
+  reference splices its graph-mode allreduce op into ``get_gradients``
+  (reference horovod/_keras/__init__.py:23-43).
+* **Single-controller** (one process driving the whole mesh): Keras 3's
+  ``keras.distribution.DataParallel`` shards the batch over the mesh and
+  XLA inserts the gradient ``psum`` during compilation — the idiomatic TPU
+  path; ``DistributedOptimizer`` is then a deliberate pass-through because
+  the gradients it sees are already global-batch gradients.
+
+Keras is imported lazily: everything here degrades to a clear
+``ImportError`` when keras isn't installed, without poisoning
+``import horovod_tpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu import basics as _basics
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+init = _hvd.init
+shutdown = _hvd.shutdown
+size = _hvd.size
+local_size = _hvd.local_size
+rank = _hvd.rank
+local_rank = _hvd.local_rank
+cross_size = _hvd.cross_size
+cross_rank = _hvd.cross_rank
+is_initialized = _hvd.is_initialized
+mpi_threads_supported = _hvd.mpi_threads_supported
+
+
+def _keras():
+    try:
+        import keras
+    except ImportError as e:  # pragma: no cover - env without keras
+        raise ImportError(
+            "horovod_tpu.keras requires keras>=3 (JAX backend).  Install "
+            "keras and set KERAS_BACKEND=jax before importing it."
+        ) from e
+    major = int(str(getattr(keras, "__version__", "0")).split(".")[0] or 0)
+    if major < 3:  # pragma: no cover - env pins keras 3
+        raise ImportError(
+            f"horovod_tpu.keras requires keras>=3, found {keras.__version__}."
+        )
+    return keras
+
+
+def _multiprocess() -> bool:
+    """The reference's process model: one rank per process.  In a
+    single-controller world the compiled SPMD path owns the collectives
+    (XLA inserts them), so the eager engine must NOT re-reduce.
+
+    Requires ``init()`` first: before it, ``jax.process_count()`` is 1
+    even in a launched multi-process world, and a silent single-controller
+    pass-through would train every rank unsynced — so ops raise
+    ``NotInitializedError`` instead (reference horovod/common/basics.py
+    pre-init behavior)."""
+    _basics._require_init()
+    import jax
+
+    return jax.process_count() > 1
+
+
+# ---------------------------------------------------------------------------
+# Value-level collectives (reference horovod/keras/__init__.py:73-115).
+#
+# The keras surface is per-PROCESS values (the reference's model), while
+# the eager engine speaks rank-major arrays; this is the same bridge the
+# torch frontend uses (torch.py:113-139): this process's local array
+# becomes its row of the rank-major global via
+# ``jax.make_array_from_process_local_data``, and the replicated result
+# is materialized back with ``device_get``.
+# ---------------------------------------------------------------------------
+
+
+def _np_to_rank_major(local: np.ndarray):
+    import jax
+
+    if local.dtype == np.int64:
+        # The wire is int32 (jax x64 off); a silently wrapped value would
+        # corrupt the collective (same guard as torch.py:118-127).
+        if local.size and (local.max() > 0x7FFFFFFF
+                           or local.min() < -0x80000000):
+            raise ValueError(
+                "int64 value holds numbers outside int32 range; the TPU "
+                "wire carries int32 (use the torch frontend's "
+                "HOROVOD_TPU_X64=1 path for exact 64-bit collectives, or "
+                "split the value)"
+            )
+    if _basics.size() == 1:
+        return jax.device_put(local[None], _basics.rank_sharding())
+    return jax.make_array_from_process_local_data(
+        _basics.rank_sharding(), np.ascontiguousarray(local)[None]
+    )
+
+
+def _from_device(arr) -> np.ndarray:
+    import jax
+
+    return np.asarray(jax.device_get(arr))
+
+
+def allreduce(value, name: str | None = None, average: bool = True):
+    """Allreduce a tensor-compatible value over ranks (identity in
+    single-controller worlds, where values are already global)."""
+    if not _multiprocess():
+        return value
+    arr = np.asarray(value)
+    out = _from_device(_eager.allreduce(
+        _np_to_rank_major(arr), average=average,
+        name=name or "keras.allreduce",
+    )).astype(arr.dtype, copy=False)  # 64-bit callers get their dtype back
+    return out.item() if np.ndim(value) == 0 else out
+
+
+def allgather(value, name: str | None = None):
+    """Allgather along dim 0.  Multi-process ranks must agree on the full
+    local shape (for rank-varying first dims use the torch frontend's
+    negotiated allgather or the JAX-native list form)."""
+    if not _multiprocess():
+        return np.asarray(value)
+    local = np.asarray(value)
+    return _from_device(_eager.allgather(
+        _np_to_rank_major(local), name=name or "keras.allgather"
+    )).astype(local.dtype, copy=False)
+
+
+def broadcast(value, root_rank: int, name: str | None = None):
+    """Broadcast a tensor-compatible value from ``root_rank``."""
+    if not _multiprocess():
+        return value
+    arr = np.asarray(value)
+    out = _from_device(_eager.broadcast(
+        _np_to_rank_major(arr), root_rank, name=name or "keras.broadcast"
+    )).astype(arr.dtype, copy=False)
+    return out.item() if np.ndim(value) == 0 else out
+
+
+def _model_variables(model) -> list:
+    vs = list(model.variables)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "built", False):
+        known = {id(v) for v in vs}
+        vs += [v for v in opt.variables if id(v) not in known]
+    return vs
+
+
+def broadcast_variables(variables: Sequence[Any], root_rank: int = 0) -> None:
+    """Assign every variable its root-rank value (eager engine broadcast).
+
+    The keras-3 analogue of the reference's session-wide
+    ``broadcast_global_variables`` (horovod/_keras/__init__.py:46-47):
+    keras 3 has no global-variable registry, so the caller names the
+    variables (typically ``model.variables`` — see
+    :func:`broadcast_global_variables` and the callback, which do)."""
+    if not _multiprocess():
+        return
+    handles = []
+    for i, v in enumerate(variables):
+        arr = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+        h = _eager.broadcast_async(_np_to_rank_major(arr), root_rank,
+                                   name=f"keras.bcast.{i}")
+        handles.append((v, arr.dtype, h))
+    for v, dt, h in handles:
+        out = _from_device(_eager.synchronize(h))
+        v.assign(out.astype(dt, copy=False))
+
+
+def broadcast_global_variables(root_rank: int, model=None) -> None:
+    """Broadcast all of ``model``'s (and its optimizer's) variables from
+    ``root_rank`` (reference horovod/keras/__init__.py:62-70).
+
+    Keras 3 keeps no global-variable collection, so the model must be
+    passed (or use ``callbacks.BroadcastGlobalVariablesCallback``, which
+    picks it up from ``fit``)."""
+    if model is None:
+        if not _multiprocess():
+            return  # nothing to sync and no registry to walk
+        raise ValueError(
+            "keras 3 has no global-variable registry; pass the model: "
+            "broadcast_global_variables(root_rank, model=model), or use "
+            "callbacks.BroadcastGlobalVariablesCallback."
+        )
+    broadcast_variables(_model_variables(model), root_rank)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference horovod/keras/__init__.py:32-59).
+# ---------------------------------------------------------------------------
+
+
+def _host_allreduce(prefix: str, compression, average: bool, arrays):
+    """Post every gradient async, then drain — the async window is what
+    lets the engine fuse small gradients into one collective (the
+    reference's tensor-fusion behavior, SURVEY.md §2.1 C5)."""
+    handles = [
+        _eager.allreduce_async(
+            _np_to_rank_major(np.asarray(a)), average=average,
+            name=f"{prefix}.grad_{i}", compression=compression,
+        )
+        for i, a in enumerate(arrays)
+    ]
+    return tuple(_from_device(_eager.synchronize(h)) for h in handles)
+
+
+def _allreduce_gradients(grads: list, *, prefix: str, compression,
+                         average: bool) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    if not _multiprocess():
+        # Single-controller: keras.distribution (or a single device) means
+        # these are already global-batch gradients; XLA owns the psum.
+        return grads
+    idx = [i for i, g in enumerate(grads) if g is not None]
+    if not idx:
+        return grads
+    arrays = [grads[i] for i in idx]
+    if any(isinstance(g, jax.core.Tracer) for g in arrays):
+        # Inside keras's jitted train step: splice the host-side eager
+        # allreduce into the compiled program.  ``ordered=True`` pins the
+        # enqueue order so every rank negotiates the same tensor sequence.
+        from jax.experimental import io_callback
+
+        shapes = tuple(
+            jax.ShapeDtypeStruct(jnp.shape(g), jnp.result_type(g))
+            for g in arrays
+        )
+
+        def host(*np_grads, _p=prefix, _c=compression, _a=average):
+            return _host_allreduce(_p, _c, _a, np_grads)
+
+        reduced = io_callback(host, shapes, *arrays, ordered=True)
+    else:
+        reduced = _host_allreduce(
+            prefix, compression, average, [np.asarray(g) for g in arrays]
+        )
+    out = list(grads)
+    for j, i in enumerate(idx):
+        out[i] = reduced[j]
+    return out
+
+
+_DIST_CLS_CACHE: dict[type, type] = {}
+
+
+def _dist_class(cls: type) -> type:
+    """One ``Distributed<Cls>`` subclass per wrapped optimizer class,
+    registered in keras's serialization registry so models saved with a
+    wrapped optimizer deserialize (registered_name
+    ``horovod_tpu.keras>Distributed<Cls>``)."""
+    dc = _DIST_CLS_CACHE.get(cls)
+    if dc is None:
+        import keras
+
+        dc = type("Distributed" + cls.__name__,
+                  (_DistributedApplyMixin, cls), {})
+        keras.saving.register_keras_serializable(
+            package="horovod_tpu.keras")(dc)
+        _DIST_CLS_CACHE[cls] = dc
+    return dc
+
+
+class _DistributedApplyMixin:
+    """Overrides ``apply`` — the single funnel both ``apply_gradients``
+    and (via ``StatelessScope``) ``stateless_apply`` drain through in
+    keras 3 — to average gradients across ranks first."""
+
+    _hvd_compression = Compression.none
+    _hvd_average = True
+    _hvd_prefix = "DistributedOptimizer"
+
+    def apply(self, grads, trainable_variables=None):
+        grads = _allreduce_gradients(
+            list(grads), prefix=self._hvd_prefix,
+            compression=self._hvd_compression, average=self._hvd_average,
+        )
+        return super().apply(grads, trainable_variables)
+
+    def get_config(self):
+        # average/name must survive a save→load_model round trip (sum
+        # semantics silently becoming mean would shrink the effective LR
+        # by size()).  Compression objects aren't config-serializable;
+        # load_model's compression= parameter is the restore path.
+        cfg = super().get_config()
+        cfg["hvd_average"] = self._hvd_average
+        cfg["hvd_prefix"] = self._hvd_prefix
+        return cfg
+
+    @classmethod
+    def from_config(cls, config, custom_objects=None):
+        config = dict(config)
+        average = config.pop("hvd_average", True)
+        prefix = config.pop("hvd_prefix", None)
+        try:
+            inst = super().from_config(config, custom_objects)
+        except TypeError:
+            inst = super().from_config(config)
+        inst._hvd_average = average
+        if prefix:
+            inst._hvd_prefix = prefix
+        return inst
+
+
+def DistributedOptimizer(optimizer, name: str | None = None,
+                         device_dense: str = "", device_sparse: str = "",
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False, *,
+                         average: bool = True):
+    """Wrap a keras optimizer so gradients are averaged over ranks before
+    the update (reference horovod/keras/__init__.py:32-59; signature kept
+    for drop-in parity — ``device_dense``/``device_sparse``/
+    ``sparse_as_dense`` are placement hints with no TPU meaning, the
+    runtime owns placement)."""
+    del device_dense, device_sparse, sparse_as_dense
+    keras = _keras()
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            "horovod_tpu.keras.DistributedOptimizer requires the JAX "
+            f"backend (got '{keras.backend.backend()}').  Set "
+            "KERAS_BACKEND=jax before importing keras."
+        )
+    cls = optimizer.__class__
+    if isinstance(optimizer, _DistributedApplyMixin):
+        raise ValueError(
+            "optimizer is already a horovod_tpu.keras DistributedOptimizer"
+        )
+    wrapped = _dist_class(cls).from_config(optimizer.get_config())
+    wrapped._hvd_compression = compression
+    wrapped._hvd_average = average
+    wrapped._hvd_prefix = name or ("Distributed" + cls.__name__)
+    if getattr(optimizer, "built", False):
+        # Preserve slot state (momentum/velocity/iteration) so wrapping a
+        # live optimizer — e.g. inside load_model — resumes training.
+        wrapped.build(optimizer._trainable_variables)
+        for sv, dv in zip(optimizer.variables, wrapped.variables):
+            dv.assign(sv)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# load_model (reference horovod/keras/__init__.py:116-148).
+# ---------------------------------------------------------------------------
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved keras model with its optimizer wrapped in
+    :func:`DistributedOptimizer`, the saved optimizer state (iterations,
+    momenta) carried into the wrapper
+    (reference horovod/keras/__init__.py:116-148).
+
+    Keras 3 mechanics: keras restores the optimizer and its variables
+    itself; a plain optimizer is then wrapped in place (state copied —
+    see :func:`DistributedOptimizer`), while a model that was SAVED with
+    a wrapped optimizer deserializes directly through the
+    ``Distributed<Cls>`` registry entries this function pre-registers."""
+    keras = _keras()
+    base = keras.optimizers.Optimizer
+    for attr in dir(keras.optimizers):
+        c = getattr(keras.optimizers, attr)
+        if isinstance(c, type) and issubclass(c, base) and c is not base:
+            _dist_class(c)
+    for c in custom_optimizers or []:
+        _dist_class(c)
+    model = keras.saving.load_model(filepath,
+                                    custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if isinstance(opt, _DistributedApplyMixin):
+        opt._hvd_compression = compression
+    elif opt is not None:
+        # Retype in place rather than swapping the attribute: the model
+        # already tracks this optimizer's variables, and a replacement
+        # object would leave the old ones tracked-but-orphaned (their
+        # buffers get purged/donated by the JAX trainer and never
+        # restored).  The subclass only adds behavior, no state.
+        opt.__class__ = _dist_class(opt.__class__)
+        opt._hvd_compression = compression
+    return model
+
+
+from horovod_tpu.keras import callbacks  # noqa: E402,F401
+
+__all__ = [
+    "init", "shutdown", "size", "local_size", "rank", "local_rank",
+    "cross_size", "cross_rank", "is_initialized", "mpi_threads_supported",
+    "Compression", "DistributedOptimizer", "allreduce", "allgather",
+    "broadcast", "broadcast_variables", "broadcast_global_variables",
+    "load_model", "callbacks",
+]

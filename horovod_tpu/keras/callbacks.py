@@ -1,0 +1,247 @@
+"""Keras-3 callbacks with the reference's Horovod callback surface
+(reference horovod/keras/callbacks.py + horovod/_keras/callbacks.py:1-168).
+
+* ``BroadcastGlobalVariablesCallback`` — rank-0 state sync at train begin
+* ``MetricAverageCallback``            — epoch-end metric allreduce
+* ``LearningRateScheduleCallback``     — epoch-window LR multiplier
+* ``LearningRateWarmupCallback``       — gradual ``lr/size → lr`` ramp
+
+Keras-3 / JAX-backend mechanics, where they differ from the keras-2
+reference:
+
+* LR lives in an optimizer *variable*, which the JAX trainer re-reads on
+  the first batch after every ``on_epoch_begin`` — staircase adjustments
+  are free.  Smooth (per-batch) adjustments must round-trip the jitted
+  state (``model.jax_state_sync()`` + ``_jax_state_synced``), which costs
+  a host sync per batch; prefer ``staircase=True`` on TPU.
+* Momentum correction: the reference temporarily sets the momentum
+  *hyperparameter* to ``m·new_lr/old_lr`` for one batch
+  (_keras/callbacks.py:104-117).  With keras 3's jitted update the
+  hyperparameter is trace-time constant, so we apply the mathematically
+  identical buffer form instead: ``v *= new_lr/old_lr`` right before the
+  first update at the new LR (``v' = m·(new/old)·v + g`` either way).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu import basics as _basics
+
+
+try:  # pragma: no cover - exercised only in keras-less envs
+    import keras as _keras_mod
+
+    _KerasCallback = _keras_mod.callbacks.Callback
+except ImportError:  # keep the module importable; constructing raises
+    class _KerasCallback:  # type: ignore[no-redef]
+        def __init__(self, *a, **kw):
+            raise ImportError(
+                "horovod_tpu.keras.callbacks requires keras>=3 "
+                "(KERAS_BACKEND=jax)."
+            )
+
+
+def _multiprocess() -> bool:
+    from horovod_tpu.keras import _multiprocess as _mp
+
+    return _mp()
+
+
+def _var_value(v) -> np.ndarray:
+    return np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+
+
+class BroadcastGlobalVariablesCallback(_KerasCallback):
+    """Broadcast all model (and any built optimizer) variables from
+    ``root_rank`` at train begin (reference _keras/callbacks.py:20-30)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        del device  # placement is runtime-owned on TPU
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_begin(self, logs=None):
+        if self.broadcast_done or not _multiprocess():
+            return
+        from horovod_tpu.keras import broadcast_variables, _model_variables
+
+        broadcast_variables(_model_variables(self.model), self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(_KerasCallback):
+    """Allreduce-average numeric epoch metrics over ranks so rank-0 logs
+    (and checkpoint/early-stop decisions) see global values
+    (reference _keras/callbacks.py:33-67)."""
+
+    def __init__(self, device: str = ""):
+        super().__init__()
+        del device
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or not _multiprocess():
+            return
+        from horovod_tpu.keras import _from_device, _np_to_rank_major
+        from horovod_tpu.ops import eager as _eager
+
+        # Post every metric async, then drain: one fused negotiation
+        # window instead of one round-trip per metric (sorted keys keep
+        # the enqueue order identical on every rank).
+        handles = {}
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)) \
+                    and not isinstance(v, bool):
+                handles[k] = _eager.allreduce_async(
+                    _np_to_rank_major(np.asarray(v, np.float32)),
+                    average=True, name=f"keras.metric.{k}",
+                )
+        for k, h in handles.items():
+            logs[k] = float(_from_device(_eager.synchronize(h)))
+
+
+class LearningRateScheduleCallback(_KerasCallback):
+    """``lr = initial_lr · multiplier(epoch)`` inside
+    ``[start_epoch, end_epoch)`` (reference _keras/callbacks.py:70-146)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: int | None = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr: float | None = None
+        self.current_epoch: int | None = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- keras-3 state plumbing ------------------------------------------
+
+    def _get_lr(self) -> float:
+        return float(_var_value(self.model.optimizer.learning_rate))
+
+    def _set_lr(self, value: float) -> None:
+        # Mutability is validated once, at on_train_begin.
+        self.model.optimizer.learning_rate.assign(value)
+
+    def _momentum_buffers(self):
+        opt = self.model.optimizer
+        if not getattr(opt, "momentum", 0.0):
+            return []
+        bufs = getattr(opt, "momentums", None)
+        if bufs:
+            return list(bufs)
+        return [v for v in opt.variables if "momentum" in getattr(v, "path", "")]
+
+    def _mid_epoch_sync(self) -> None:
+        """Round-trip the jitted train state through the variables so a
+        mid-epoch assignment is visible to the next step (the trainer's
+        own 'synced by a callback' hook)."""
+        m = self.model
+        if getattr(m, "_jax_state", None) is not None \
+                and hasattr(m, "jax_state_sync"):
+            m.jax_state_sync()
+
+    def _adjust_learning_rate(self, epoch: float, *, mid_epoch: bool) -> None:
+        if mid_epoch:
+            # jax_state_sync() also flags the synced state so the next
+            # step re-reads the variables we're about to assign.
+            self._mid_epoch_sync()
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * float(self.multiplier(epoch))
+        self._set_lr(new_lr)
+        if self.momentum_correction and old_lr > 0 and new_lr != old_lr:
+            scale = new_lr / old_lr
+            for buf in self._momentum_buffers():
+                buf.assign(_var_value(buf) * scale)
+
+    # -- reference-shaped hooks ------------------------------------------
+
+    def _autodetect_steps_per_epoch(self) -> int:
+        if self.params and self.params.get("steps"):
+            return self.params["steps"]
+        raise ValueError(
+            "Could not autodetect steps_per_epoch; pass steps_per_epoch= "
+            f"to {self.__class__.__name__}()."
+        )
+
+    def on_train_begin(self, logs=None):
+        if not hasattr(self.model.optimizer.learning_rate, "assign"):
+            # Fail at train begin, not mid-epoch: an optimizer built on a
+            # LearningRateSchedule object owns the LR itself.
+            raise ValueError(
+                f"{self.__class__.__name__} requires a mutable "
+                "learning_rate variable; the optimizer was constructed "
+                "with a schedule object instead."
+            )
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch is None
+                or self.current_epoch < self.start_epoch
+                or (self.end_epoch is not None
+                    and self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            # Epoch boundary: the trainer re-reads variables on this very
+            # step (no state round-trip needed).
+            self._adjust_learning_rate(self.current_epoch, mid_epoch=False)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch, mid_epoch=batch != 0)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual ramp from ``initial_lr/size`` to ``initial_lr`` over
+    ``warmup_epochs`` (reference _keras/callbacks.py:149-168; the
+    Goyal et al. warm-up — the user scales the configured LR by ``size``,
+    the callback walks it up from the single-worker value)."""
+
+    def __init__(self, warmup_epochs: float = 5, momentum_correction:
+                 bool = True, steps_per_epoch: int | None = None,
+                 verbose: int = 0):
+        def multiplier(epoch):
+            # +1/steps so epoch-end values land on round numbers
+            # (reference's TensorBoard nicety).
+            epoch += 1.0 / self.steps_per_epoch
+            n = _basics.size()
+            return 1.0 / n * (epoch * (n - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0 \
+                and _basics.rank() == 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr():g}.")
+
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+]

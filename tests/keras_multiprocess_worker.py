@@ -1,0 +1,113 @@
+"""Worker for the Keras-3 frontend under REAL process separation: two
+ranks, each driving one CPU device, running ``model.fit`` with
+``horovod_tpu.keras.DistributedOptimizer`` — the gradient allreduce rides
+``io_callback`` inside Keras's jitted train step, through the eager
+engine's native control plane (the reference's process model:
+horovod/keras/__init__.py driven under ``mpirun -np 2``).
+
+Checks, in order:
+1. eager ``apply`` path: rank-dependent gradients come out averaged;
+2. ``BroadcastGlobalVariablesCallback``: divergent initial weights are
+   rank-0's after train begin;
+3. a 2-epoch ``fit`` on rank-DIFFERENT data keeps weights bit-identical
+   across ranks (averaged grads + identical start = identical
+   trajectory), and ``MetricAverageCallback`` rewrites epoch logs;
+4. value-level ``hvd.allreduce``/``broadcast`` round-trips.
+
+Prints ``WORKER_OK {json}`` on success.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["KERAS_BACKEND"] = "jax"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import keras
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    me = hvd.rank()
+    n = hvd.size()
+    assert n == 2, f"this worker expects a 2-rank world, got {n}"
+
+    # --- 1. eager apply: grads averaged across ranks -------------------
+    keras.utils.set_random_seed(1234)  # identical model on both ranks
+    model = keras.Sequential(
+        [keras.layers.Dense(4, input_shape=(3,)), keras.layers.Dense(1)]
+    )
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=1.0))
+    opt.build(model.trainable_variables)
+    before = [v.numpy().copy() for v in model.trainable_variables]
+    grads = [
+        np.full(v.shape, float(me + 1), np.float32)
+        for v in model.trainable_variables
+    ]
+    opt.apply(grads, model.trainable_variables)
+    # mean(1, 2) = 1.5, lr 1.0 → every weight moved by exactly -1.5.
+    for b, v in zip(before, model.trainable_variables):
+        delta = np.asarray(v.numpy()) - b
+        assert np.allclose(delta, -1.5, atol=1e-6), (me, delta.ravel()[:3])
+
+    # --- 2. broadcast callback syncs divergent weights to rank 0 -------
+    keras.utils.set_random_seed(100 + me)  # now DIVERGE the weights
+    model2 = keras.Sequential(
+        [keras.layers.Dense(8, input_shape=(6,)), keras.layers.Dense(2)]
+    )
+    model2.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)
+        ),
+        loss="mse",
+    )
+    w_root = hvd.broadcast(model2.layers[0].kernel.numpy(), root_rank=0,
+                           name="probe.w0")
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(0)
+    cb.set_model(model2)
+    cb.on_train_begin()
+    assert np.array_equal(model2.layers[0].kernel.numpy(), w_root), me
+
+    # --- 3. fit on rank-different data → identical trajectories --------
+    rng = np.random.RandomState(7 + me)  # DIFFERENT data per rank
+    x = rng.randn(32, 6).astype(np.float32)
+    y = rng.randn(32, 2).astype(np.float32)
+    hist = model2.fit(
+        x, y, batch_size=8, epochs=2, shuffle=False, verbose=0,
+        callbacks=[hvd.callbacks.MetricAverageCallback()],
+    )
+    final = np.concatenate(
+        [v.numpy().ravel() for v in model2.trainable_variables]
+    )
+    gathered = hvd.allgather(final[None, :], name="final.weights")
+    assert gathered.shape[0] == 2, gathered.shape
+    assert np.array_equal(gathered[0], gathered[1]), (
+        me, np.abs(gathered[0] - gathered[1]).max()
+    )
+    # Metric averaging produced a global loss: both ranks log the same.
+    losses = np.asarray(hist.history["loss"], np.float64)
+    other = hvd.allreduce(losses, name="probe.losses", average=True)
+    assert np.allclose(losses, other, rtol=1e-12), (me, losses, other)
+
+    # --- 4. value-level ops -------------------------------------------
+    assert hvd.allreduce(float(me), name="scalar") == 0.5
+    assert hvd.broadcast(float(me + 5), root_rank=1, name="bscalar") == 6.0
+
+    print("WORKER_OK " + json.dumps({
+        "rank": me, "final_norm": float(np.linalg.norm(final)),
+        "loss0": float(losses[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

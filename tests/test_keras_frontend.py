@@ -1,0 +1,262 @@
+"""Keras-3 (JAX backend) frontend: the reference's Keras surface
+(reference horovod/keras/__init__.py, horovod/_keras/callbacks.py) driven
+through keras ``model.fit`` on the virtual 8-device CPU mesh.
+
+Single-controller regime here (one process, mesh of 8): gradients under
+``keras.distribution.DataParallel`` are already global — XLA inserts the
+psum — so ``DistributedOptimizer`` is a pass-through; what these tests pin
+is the wrapper mechanics, the callback schedule math (lr variable + the
+momentum-buffer form of momentum correction), and ``load_model``'s
+optimizer re-wrap.  The multi-process allreduce path is exercised under
+real process separation in
+test_multiprocess.py::test_keras_frontend_two_ranks.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+keras = pytest.importorskip("keras")
+if keras.backend.backend() != "jax":  # pragma: no cover - env guard
+    pytest.skip("keras is bound to a non-jax backend in this interpreter",
+                allow_module_level=True)
+
+import jax  # noqa: E402
+
+import horovod_tpu.keras as hvdk  # noqa: E402
+
+
+def _model(in_dim=6, out_dim=2, seed=0):
+    keras.utils.set_random_seed(seed)
+    return keras.Sequential(
+        [keras.layers.Dense(8, input_shape=(in_dim,), activation="relu"),
+         keras.layers.Dense(out_dim)]
+    )
+
+
+def _data(n=64, in_dim=6, out_dim=2, seed=3):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, in_dim).astype(np.float32),
+            rng.randn(n, out_dim).astype(np.float32))
+
+
+def test_distributed_optimizer_wraps_and_fits():
+    model = _model()
+    opt = hvdk.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.05))
+    assert type(opt).__name__ == "DistributedSGD"
+    assert isinstance(opt, keras.optimizers.SGD)
+    model.compile(optimizer=opt, loss="mse")
+    x, y = _data()
+    hist = model.fit(x, y, batch_size=16, epochs=3, verbose=0)
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], losses
+    with pytest.raises(ValueError, match="already"):
+        hvdk.DistributedOptimizer(opt)
+
+
+def test_distributed_optimizer_passthrough_gradients_single_controller():
+    """One controller: apply() must hand gradients through unchanged."""
+    model = _model()
+    opt = hvdk.DistributedOptimizer(keras.optimizers.SGD(learning_rate=1.0))
+    opt.build(model.trainable_variables)
+    before = [v.numpy().copy() for v in model.trainable_variables]
+    grads = [np.full(v.shape, 2.0, np.float32)
+             for v in model.trainable_variables]
+    opt.apply(grads, model.trainable_variables)
+    for b, v in zip(before, model.trainable_variables):
+        assert np.allclose(np.asarray(v.numpy()) - b, -2.0, atol=1e-6)
+
+
+def test_distributed_optimizer_preserves_built_state():
+    model = _model()
+    inner = keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+    model.compile(optimizer=inner, loss="mse")
+    x, y = _data()
+    model.fit(x, y, batch_size=16, epochs=1, verbose=0)  # builds slots
+    it_before = int(inner.iterations.numpy())
+    assert it_before == 4
+    wrapped = hvdk.DistributedOptimizer(inner)
+    assert wrapped.built
+    assert int(wrapped.iterations.numpy()) == it_before
+    for sv, dv in zip(inner.variables, wrapped.variables):
+        assert np.array_equal(np.asarray(sv.numpy()), np.asarray(dv.numpy()))
+
+
+def test_fit_under_data_parallel_mesh():
+    """keras.distribution.DataParallel over the 8-device mesh — the
+    single-controller TPU path: batch sharded, XLA owns the psum."""
+    dist = keras.distribution.DataParallel(devices=jax.devices())
+    keras.distribution.set_distribution(dist)
+    try:
+        model = _model()
+        model.compile(
+            optimizer=hvdk.DistributedOptimizer(
+                keras.optimizers.SGD(learning_rate=0.05)
+            ),
+            loss="mse",
+        )
+        x, y = _data(n=128)
+        hist = model.fit(x, y, batch_size=32, epochs=2, verbose=0,
+                         callbacks=[
+                             hvdk.callbacks.BroadcastGlobalVariablesCallback(0),
+                             hvdk.callbacks.MetricAverageCallback(),
+                         ])
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+    finally:
+        keras.distribution.set_distribution(None)
+
+
+def test_warmup_callback_ramps_lr_to_initial():
+    model = _model()
+    base_lr = 0.08
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=base_lr)), loss="mse")
+    x, y = _data(n=64)
+    warmup = hvdk.callbacks.LearningRateWarmupCallback(warmup_epochs=2,
+                                                       verbose=0)
+    hist = model.fit(x, y, batch_size=16, epochs=3, verbose=0,
+                     shuffle=False, callbacks=[warmup])
+    lrs = hist.history["lr"]
+    assert len(lrs) == 3
+    # Ramp: strictly increasing through the window, landing on the
+    # configured LR at the end of warmup (multiplier → 1), then flat.
+    assert lrs[0] < lrs[1] <= base_lr + 1e-9, lrs
+    assert lrs[1] == pytest.approx(base_lr, rel=1e-5), lrs
+    assert lrs[2] == pytest.approx(base_lr, rel=1e-5), lrs
+    # First-epoch start point is the reference's 1/size ramp origin.
+    n = hvdk.size()
+    assert lrs[0] > base_lr / n
+    assert float(model.optimizer.learning_rate.numpy()) == \
+        pytest.approx(base_lr, rel=1e-5)
+
+
+def test_schedule_callback_staircase_and_momentum_buffers():
+    model = _model()
+    inner = keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+    model.compile(optimizer=inner, loss="mse")
+    x, y = _data()
+    model.fit(x, y, batch_size=16, epochs=1, verbose=0)  # nonzero buffers
+
+    cb = hvdk.callbacks.LearningRateScheduleCallback(
+        multiplier=0.5, start_epoch=0, staircase=True,
+        momentum_correction=True)
+    cb.set_model(model)
+    cb.on_train_begin()
+    bufs = cb._momentum_buffers()
+    assert bufs, "SGD(momentum=0.9) must expose momentum buffers"
+    before = [np.asarray(b.numpy()).copy() for b in bufs]
+    assert any(np.abs(b).max() > 0 for b in before)
+
+    cb.on_epoch_begin(0)
+    cb.on_train_batch_begin(0)
+    assert float(model.optimizer.learning_rate.numpy()) == \
+        pytest.approx(0.05, rel=1e-6)
+    # Momentum correction, buffer form: v *= new_lr/old_lr = 0.5.
+    for b0, b in zip(before, bufs):
+        assert np.allclose(np.asarray(b.numpy()), b0 * 0.5, rtol=1e-6)
+
+    # Second adjustment at the SAME lr: buffers must NOT be rescaled.
+    cb.on_epoch_begin(1)
+    cb.on_train_batch_begin(0)
+    for b0, b in zip(before, bufs):
+        assert np.allclose(np.asarray(b.numpy()), b0 * 0.5, rtol=1e-6)
+
+    logs: dict = {}
+    cb.on_epoch_end(1, logs)
+    assert logs["lr"] == pytest.approx(0.05, rel=1e-6)
+
+
+def test_schedule_callback_rejects_lr_schedule_object():
+    model = _model()
+    model.compile(optimizer=keras.optimizers.SGD(
+        learning_rate=keras.optimizers.schedules.ExponentialDecay(
+            0.1, 10, 0.9)), loss="mse")
+    cb = hvdk.callbacks.LearningRateScheduleCallback(multiplier=0.5)
+    cb.set_model(model)
+    with pytest.raises(ValueError, match="schedule"):
+        cb.on_train_begin()
+
+
+def test_load_model_rewraps_optimizer(tmp_path):
+    model = _model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.07,
+                                                 momentum=0.9), loss="mse")
+    x, y = _data()
+    model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+
+    loaded = hvdk.load_model(path)
+    from horovod_tpu.keras import _DistributedApplyMixin
+
+    assert isinstance(loaded.optimizer, _DistributedApplyMixin)
+    assert isinstance(loaded.optimizer, keras.optimizers.SGD)
+    assert float(loaded.optimizer.learning_rate.numpy()) == \
+        pytest.approx(0.07, rel=1e-6)
+    # Saved optimizer state carried into the wrapper.
+    assert int(loaded.optimizer.iterations.numpy()) == \
+        int(model.optimizer.iterations.numpy())
+    for a, b in zip(model.trainable_variables, loaded.trainable_variables):
+        assert np.array_equal(np.asarray(a.numpy()), np.asarray(b.numpy()))
+    # Training resumes through the wrapper.
+    hist = loaded.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    assert np.isfinite(hist.history["loss"][0])
+
+    # A model SAVED with a wrapped optimizer ("DistributedSGD") loads too.
+    path2 = str(tmp_path / "m2.keras")
+    loaded.save(path2)
+    again = hvdk.load_model(path2)
+    assert isinstance(again.optimizer, _DistributedApplyMixin)
+
+
+def test_load_model_preserves_average_and_name(tmp_path):
+    """Sum semantics (average=False) must survive a save→load round trip
+    — silently reverting to mean would shrink the effective LR by
+    size()."""
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.01), name="sumopt",
+        average=False), loss="mse")
+    x, y = _data()
+    model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "s.keras")
+    model.save(path)
+    loaded = hvdk.load_model(path)
+    assert loaded.optimizer._hvd_average is False
+    assert loaded.optimizer._hvd_prefix == "sumopt"
+
+
+def test_value_level_ops_single_controller_identity():
+    assert hvdk.allreduce(3.5) == 3.5
+    assert hvdk.broadcast(2.25, root_rank=0) == 2.25
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert np.array_equal(hvdk.allgather(arr), arr)
+
+
+def test_ops_raise_before_init():
+    """Pre-init ops must raise, not silently pass through as
+    single-controller (a launched world has process_count()==1 until
+    init() brings up jax.distributed — a silent no-op would train every
+    rank unsynced)."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    try:
+        with pytest.raises(hvd.NotInitializedError):
+            hvdk.allreduce(1.0)
+        with pytest.raises(hvd.NotInitializedError):
+            hvdk.broadcast_variables([], 0)
+    finally:
+        hvd.init()
+
+
+def test_broadcast_global_variables_requires_model_when_multiprocess():
+    # Single controller: model-less call is a documented no-op.
+    hvdk.broadcast_global_variables(0)
+    model = _model()
+    model.compile(optimizer=keras.optimizers.SGD(), loss="mse")
+    hvdk.broadcast_global_variables(0, model=model)  # no-op, must not raise

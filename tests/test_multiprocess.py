@@ -561,3 +561,19 @@ def test_pytorch_elastic_example_via_launcher(tmp_path):
                         timeout=300, cwd=os.path.dirname(HERE))
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert "epoch 0: loss" not in r2.stdout     # resumed past the end
+
+
+@pytest.mark.slow
+def test_keras_frontend_two_ranks():
+    """The Keras-3 frontend under real process separation: two ranks run
+    ``model.fit`` with DistributedOptimizer — the gradient allreduce rides
+    io_callback inside keras's jitted train step through the eager engine
+    — plus the broadcast/metric callbacks and value-level ops (the
+    reference's ``mpirun -np 2`` keras CI shape)."""
+    pytest.importorskip("keras")
+    outs = _run_workers(
+        os.path.join(HERE, "keras_multiprocess_worker.py"), 2,
+        {"KERAS_BACKEND": "jax"}, timeout=600,
+    )
+    for i, out in enumerate(outs):
+        assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
