@@ -279,6 +279,7 @@ def _honor_platform_env() -> None:
 def init(
     devices: Sequence[jax.Device] | None = None,
     mesh: Mesh | None = None,
+    comm=None,
 ) -> None:
     """Initialize the framework.  Analogue of ``hvd.init()``
     (reference horovod/common/__init__.py:58-84 → operations.cc:2011-2029).
@@ -289,12 +290,43 @@ def init(
         all devices.
       mesh: optional pre-built 1-D mesh whose single axis becomes the Horovod
         world.  Overrides ``devices``.
+      comm: reference-parity spelling of the subset form: a list of ints
+        selects those ranks' chips — ``init(comm=[0, 2])`` ≡
+        ``init(devices=[jax.devices()[0], jax.devices()[2]])``.  An mpi4py
+        communicator is not a TPU concept (there is no MPI runtime to
+        share); passing one raises with that explanation.
     """
+    if comm is not None:
+        if devices is not None or mesh is not None:
+            raise ValueError("init(): pass comm= or devices=/mesh=, not both")
+        if not (isinstance(comm, (list, tuple)) and comm and all(
+            isinstance(r, int) and not isinstance(r, bool) for r in comm
+        )):
+            raise TypeError(
+                "init(comm=...) takes a non-empty list of int ranks on "
+                "TPU.  MPI communicators don't exist here — the process "
+                "world comes from jax.distributed (the launcher sets it "
+                "up); for a rank-subset world pass the rank list, for "
+                "subset COLLECTIVES on a full world use hvd.ProcessSet."
+            )
     with _state.lock:
         if _state.initialized:
             return
         _honor_platform_env()
         _maybe_init_distributed()
+        if comm is not None:
+            # Resolve ranks only AFTER the platform pin and the
+            # jax.distributed bring-up: jax.devices() commits the XLA
+            # backend, and calling it first would poison both (the
+            # invariant _maybe_init_distributed documents).
+            all_devs = jax.devices()
+            bad = [r for r in comm if not 0 <= r < len(all_devs)]
+            if bad:
+                raise ValueError(
+                    f"init(comm={list(comm)}): ranks {bad} outside "
+                    f"[0, {len(all_devs)})"
+                )
+            devices = [all_devs[r] for r in comm]
         if mesh is not None:
             if len(mesh.axis_names) != 1:
                 raise ValueError(

@@ -447,3 +447,28 @@ def test_vit_arm_rehearsal_path(bench, monkeypatch):
     out = bench._bench_vit(hvd, True)
     assert out["vit_b16_images_per_sec_per_chip"] > 0
     assert out["vit_shape"] == "b2_img16_tiny"
+
+
+def test_eager_overhead_bench_single_arm():
+    """tools/eager_overhead_bench.py --mode single: one arm end-to-end in
+    a subprocess (the docs/benchmarks.md "Eager engine overhead" table's
+    producer), RESULT line parseable with sane fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", EAGER_OVH_ROUNDS="2",
+               EAGER_OVH_BURST="4")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "tools", "eager_overhead_bench.py"),
+         "--mode", "single", "--threshold", str(64 * 1024 * 1024)],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if l.startswith("RESULT ")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0].split("RESULT ", 1)[1])
+    assert rec["arm"] == "single.fused"
+    assert rec["ops_per_sec"] > 0
+    assert rec["tensors_fused"] == 8  # 2 rounds x 4-tensor fused bursts

@@ -156,3 +156,19 @@ def test_jax_elastic_example(tmp_path):
         ["--epochs", "1", "--batch-per-chip", "4", "--samples", "256",
          "--commit-every", "4", "--ckpt-dir", str(tmp_path)],
     )
+
+
+def test_keras3_mnist(tmp_path):
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    keras = pytest.importorskip("keras")
+    if keras.backend.backend() != "jax":
+        pytest.skip("keras bound to a non-jax backend in this interpreter")
+    try:
+        run_example(
+            "keras3_mnist.py",
+            ["--epochs", "2", "--batch-per-chip", "4", "--samples", "256",
+             "--ckpt-dir", str(tmp_path)],
+        )
+    finally:
+        keras.distribution.set_distribution(None)
+    assert (tmp_path / "model.keras").exists()

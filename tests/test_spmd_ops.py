@@ -191,3 +191,39 @@ def test_broadcast_process_set_lowering_single_allreduce():
     stablehlo = f.lower(x).as_text()
     assert stablehlo.count("all_reduce") == 1, stablehlo
     assert "all_gather" not in stablehlo
+
+
+def test_init_comm_rank_subset_and_rejections():
+    """init(comm=[ranks]) is the reference-parity spelling of the device
+    subset (reference horovod/common/__init__.py:58-84); non-int-list
+    comms (mpi4py) are rejected with guidance, and comm= conflicts with
+    devices=/mesh=."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    try:
+        hvd.init(comm=[0, 2, 5])
+        assert hvd.size() == 3
+        devs = hvd.mesh().devices.tolist()
+        assert [d.id for d in devs] == [jax.devices()[r].id for r in (0, 2, 5)]
+        hvd.shutdown()
+        # Rank resolution happens inside init (after the platform pin),
+        # so out-of-range only surfaces on a world that would come up.
+        with pytest.raises(ValueError, match="outside"):
+            hvd.init(comm=[0, 99])
+    finally:
+        hvd.shutdown()
+        hvd.init()
+
+    # Argument-shape validation is unconditional (even when initialized).
+    with pytest.raises(TypeError, match="MPI"):
+        hvd.init(comm=object())
+    with pytest.raises(TypeError, match="non-empty"):
+        hvd.init(comm=[])
+    with pytest.raises(TypeError, match="int ranks"):
+        hvd.init(comm=[True, False])
+    with pytest.raises(ValueError, match="not both"):
+        hvd.init(comm=[0], devices=jax.devices()[:1])
+    assert hvd.is_initialized()  # the failed calls left the world alone
