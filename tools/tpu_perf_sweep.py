@@ -206,16 +206,21 @@ def llama_sweep():
     from horovod_tpu.models import llama
 
     seq = 2048 if _ON_TPU else 128
-    for name, kw in (
-        ("flash", dict(attn_impl="flash", remat=False)),
-        ("flash_remat", dict(attn_impl="flash", remat=True)),
-        ("dense", dict(attn_impl="dense", remat=False)),
+    base_shape = dict(vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+                      n_kv_heads=4, ffn_dim=4096)
+    # ~570M params: MFU rises with model size (bigger matmuls occupy the
+    # MXU better than the 189M bench model's); remat+donation make it fit.
+    big_shape = dict(vocab_size=32768, dim=1536, n_layers=14, n_heads=16,
+                     n_kv_heads=4, ffn_dim=6144)
+    for name, kw, shape in (
+        ("flash", dict(attn_impl="flash", remat=False), base_shape),
+        ("flash_remat", dict(attn_impl="flash", remat=True), base_shape),
+        ("dense", dict(attn_impl="dense", remat=False), base_shape),
+        ("flash_big", dict(attn_impl="flash", remat=True), big_shape),
     ):
         note(f"llama {name}: building")
         if _ON_TPU:
-            cfg = llama.llama_tiny(
-                vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
-                n_kv_heads=4, ffn_dim=4096, max_seq_len=seq, **kw)
+            cfg = llama.llama_tiny(max_seq_len=seq, **shape, **kw)
         else:
             cfg = llama.llama_tiny(max_seq_len=seq, **kw)
         loss = llama.make_loss_fn(cfg)
