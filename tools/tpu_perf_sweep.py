@@ -212,22 +212,38 @@ def llama_sweep():
     # MXU better than the 189M bench model's); remat+donation make it fit.
     big_shape = dict(vocab_size=32768, dim=1536, n_layers=14, n_heads=16,
                      n_kv_heads=4, ffn_dim=6144)
+    # 1.11B: the single-chip capacity ceiling — fits ONLY with the full
+    # memory ladder (remat + fused loss + donation + SGD-momentum's 1x
+    # state; fp32 params 4.4G + momentum 4.4G of the 15.75G HBM).
+    onex_shape = dict(vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+                      n_kv_heads=4, ffn_dim=8192)
     for name, kw, shape in (
         ("flash", dict(attn_impl="flash", remat=False), base_shape),
         ("flash_remat", dict(attn_impl="flash", remat=True), base_shape),
         ("dense", dict(attn_impl="dense", remat=False), base_shape),
         ("flash_big", dict(attn_impl="flash", remat=True), big_shape),
+        ("flash_1b", dict(attn_impl="flash", remat=True,
+                          fused_loss_chunk=2048), onex_shape),
     ):
+        if not _ON_TPU and name == "flash_big":
+            # Off-TPU the shape is discarded, which would make this rung
+            # byte-identical to flash_remat — skip the duplicate (flash_1b
+            # still differs off-TPU: it smokes the fused-loss path).
+            continue
         note(f"llama {name}: building")
         if _ON_TPU:
             cfg = llama.llama_tiny(max_seq_len=seq, **shape, **kw)
         else:
             cfg = llama.llama_tiny(max_seq_len=seq, **kw)
         loss = llama.make_loss_fn(cfg)
-        tx = hvd.DistributedOptimizer(optax.adamw(1e-4))
+        # AdamW's 2x fp32 state does not fit at 1B on one chip; SGD-momentum
+        # (the reference benchmarks' optimizer) is the 1B rung's point.
+        opt = optax.sgd(1e-3, momentum=0.9) if name == "flash_1b" \
+            else optax.adamw(1e-4)
+        tx = hvd.DistributedOptimizer(opt)
         params = llama.init_params(cfg, jax.random.key(0))
         opt_state = jax.jit(tx.init)(params)
-        lbs = 4 if _ON_TPU else 8       # smoke: divisible by the 8-mesh
+        lbs = (2 if name == "flash_1b" else 4) if _ON_TPU else 8
         tokens = jax.random.randint(
             jax.random.key(11), (lbs, seq), 0, cfg.vocab_size, jnp.int32)
         batch = (tokens, tokens)
