@@ -136,14 +136,24 @@ def allreduce(value, name: str | None = None, average: bool = True):
 
 
 def allgather(value, name: str | None = None):
-    """Allgather along dim 0.  Multi-process ranks must agree on the full
-    local shape (for rank-varying first dims use the torch frontend's
-    negotiated allgather or the JAX-native list form)."""
+    """Allgather along dim 0; ranks may disagree on dim 0 (the
+    reference's unequal-first-dim allgather,
+    horovod/keras/__init__.py:89-101 → operations.cc:841-901).  Sizes
+    are negotiated through the engine up front."""
     if not _multiprocess():
         return np.asarray(value)
     local = np.asarray(value)
+    name = name or "keras.allgather"
+    sizes = _eager.negotiate_gather_sizes(local.shape, str(local.dtype),
+                                          name)
+    pad = max(sizes)
+    if local.shape[0] != pad:
+        padded = np.zeros((pad,) + local.shape[1:], local.dtype)
+        padded[: local.shape[0]] = local
+        local = padded
+    # The engine slices the ragged concatenation itself (sizes=).
     return _from_device(_eager.allgather(
-        _np_to_rank_major(local), name=name or "keras.allgather"
+        _np_to_rank_major(local), name=name, sizes=sizes
     )).astype(local.dtype, copy=False)
 
 

@@ -43,6 +43,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -1098,14 +1099,24 @@ def sparse_allreduce(tensor, name: str | None = None, *, average: bool = False,
 
 
 def allgather_async(tensors, name: str | None = None, *,
-                    process_set=None) -> int:
+                    process_set=None, sizes=None) -> int:
     """Async allgather; ``tensors`` is rank-major or a list of per-rank
     tensors whose first dims may differ (reference allgather-with-unequal-
     first-dims, operations.cc:841-901 — size negotiation happens host-side
-    here since the controller sees every rank's shape)."""
+    here since the controller sees every rank's shape).
+
+    ``sizes``: for RANK-MAJOR input ``[size, pad, ...]``, the per-rank
+    true first dims (each ≤ pad) from
+    :func:`negotiate_gather_sizes` — the engine then returns the ragged
+    concatenation directly (one slicing implementation for the list,
+    torch, and keras frontends).  The list form negotiates its own."""
     eng = _engine()
-    sizes = None
     if isinstance(tensors, (list, tuple)):
+        if sizes is not None:
+            raise ValueError(
+                "sizes= applies to rank-major input only (the per-rank "
+                "list form derives sizes from the tensors themselves)"
+            )
         n = basics.size()
         if len(tensors) != n:
             raise ValueError(f"expected {n} per-rank tensors, got {len(tensors)}")
@@ -1132,6 +1143,25 @@ def allgather_async(tensors, name: str | None = None, *,
             sizes = None
     else:
         t = _as_rank_major(tensors, "allgather")
+        if sizes is not None:
+            sizes = tuple(int(s) for s in sizes)
+            if t.ndim < 2:
+                raise ValueError(
+                    "ragged allgather needs rank-major [size, pad, ...] "
+                    f"input; got shape {t.shape}"
+                )
+            if len(sizes) != t.shape[0]:
+                raise ValueError(
+                    f"sizes must have one entry per rank ({t.shape[0]}); "
+                    f"got {len(sizes)}"
+                )
+            pad = int(t.shape[1])
+            if any(not 0 <= s <= pad for s in sizes):
+                raise ValueError(
+                    f"sizes must lie in [0, padded dim {pad}]; got {sizes}"
+                )
+            if len(set(sizes)) == 1 and sizes[0] == pad:
+                sizes = None    # not actually ragged: plain gather
     if process_set is not None and process_set.ranks[-1] >= basics.size():
         raise ValueError(
             f"process set {process_set.ranks} exceeds world size "
@@ -1152,11 +1182,78 @@ def allgather_async(tensors, name: str | None = None, *,
     return h
 
 
-def allgather(tensors, name: str | None = None, *, process_set=None):
+def allgather(tensors, name: str | None = None, *, process_set=None,
+              sizes=None):
     """Blocking allgather.  With a ``process_set``, the result is the
     concatenation of MEMBER ranks' slices only (set order)."""
     return synchronize(allgather_async(tensors, name,
-                                       process_set=process_set))
+                                       process_set=process_set,
+                                       sizes=sizes))
+
+
+MAX_GATHER_NDIM = 8
+
+
+def negotiate_gather_sizes(shape: Sequence[int], dtype_str: str,
+                           name: str | None = None) -> list[int]:
+    """Exchange (ndim, dtype, shape) across ranks THROUGH the engine — not
+    an out-of-band host collective, so it serializes with every queued
+    engine op (no cross-host op-order divergence) — and return the
+    per-rank dim-0 sizes for a ragged allgather (the reference's
+    unequal-first-dim negotiation, operations.cc:841-901).
+
+    Frontend-agnostic: callers pass the local shape and a dtype STRING
+    (consistent within a frontend: every rank runs the same one).  Raises
+    the same clean errors for ndim/dtype/trailing-dim mismatch on every
+    rank.  Used by the torch and keras frontends."""
+    import zlib
+
+    ndim = len(shape)
+    if ndim < 1:
+        raise ValueError("allgather expects a tensor with >= 1 dim")
+    if ndim > MAX_GATHER_NDIM:
+        raise ValueError(
+            f"allgather supports up to {MAX_GATHER_NDIM} dims, got {ndim}"
+        )
+    # int32 end-to-end: jax's default x64-truncation would silently fold
+    # int64 digests and break the cross-rank comparison.  Dims that don't
+    # fit int32 would wrap silently, so reject them up front.
+    if any(d > 0x7FFFFFFF for d in shape):
+        raise ValueError(
+            "allgather: tensor dims must fit in int32 for the cross-rank "
+            f"shape negotiation; got shape {tuple(shape)}"
+        )
+    digest = np.zeros((2 + MAX_GATHER_NDIM,), np.int32)
+    digest[0] = ndim
+    # crc32, not hash(): Python's str hash is per-process randomized.
+    digest[1] = zlib.crc32(dtype_str.encode()) & 0x7FFFFFFF
+    digest[2:2 + ndim] = list(shape)
+    n = basics.size()
+    if n == 1:
+        g = jax.device_put(digest[None], basics.rank_sharding())
+    else:
+        g = jax.make_array_from_process_local_data(
+            basics.rank_sharding(), digest[None]
+        )
+    h = allgather_async(g, name=None if name is None else f"{name}.shapes")
+    all_digest = np.asarray(
+        jax.device_get(synchronize(h))
+    ).reshape(n, 2 + MAX_GATHER_NDIM)
+    for r in range(n):
+        if all_digest[r, 0] != ndim or all_digest[r, 1] != digest[1]:
+            raise ValueError(
+                "allgather: per-rank tensors must share ndim and dtype; "
+                f"rank {r} disagrees ({all_digest[r, :2].tolist()} vs "
+                f"{digest[:2].tolist()})"
+            )
+        if list(all_digest[r, 3:2 + ndim]) != list(shape[1:]):
+            raise ValueError(
+                "allgather: per-rank tensors must agree on all dims except "
+                f"dim 0; rank {r} has trailing "
+                f"{all_digest[r, 3:2 + ndim].tolist()} vs local "
+                f"{list(shape[1:])}"
+            )
+    return [int(all_digest[r, 2]) for r in range(n)]
 
 
 def alltoall_async(tensor, name: str | None = None) -> int:

@@ -312,67 +312,23 @@ def allreduce_async_(tensor, average=True, name=None, *, op=None,
     return h
 
 
-# Post-processing for ragged allgathers / rank-major results rides the
-# HandleManager entry itself (set_handle_post/take_handle_post) — under the
-# manager's lock, released with the handle — so an abandoned handle or a
-# raising synchronize() cannot leak frontend bookkeeping.
-_MAX_GATHER_NDIM = 8
+# Post-processing for rank-major results rides the HandleManager entry
+# itself (set_handle_post/take_handle_post) — under the manager's lock,
+# released with the handle — so an abandoned handle or a raising
+# synchronize() cannot leak frontend bookkeeping.  (Ragged allgather
+# slicing lives in the ENGINE: allgather_async(sizes=).)
 
 
 def _negotiate_gather_shapes(tensor, name):
-    """Exchange (ndim, dtype, shape) across ranks THROUGH the engine — not
-    an out-of-band host collective, so it serializes with every queued
-    engine op (no cross-host op-order divergence) and the result is
-    rank-ordered like the gathered rows themselves.  Returns the CPU copy
-    of the local tensor and the per-rank dim-0 sizes; raises the same
-    clean errors as the eager list form for trailing-dim/dtype mismatch."""
-    torch = _torch()
+    """Exchange (ndim, dtype, shape) across ranks through the engine
+    (the shared :func:`horovod_tpu.ops.eager.negotiate_gather_sizes`).
+    Returns the CPU copy of the local tensor and the per-rank dim-0
+    sizes; raises the same clean errors as the eager list form for
+    trailing-dim/dtype mismatch."""
     local = tensor.detach().cpu()
-    if local.dim() < 1:
-        raise ValueError("allgather expects a tensor with >= 1 dim")
-    if local.dim() > _MAX_GATHER_NDIM:
-        raise ValueError(
-            f"allgather supports up to {_MAX_GATHER_NDIM} dims, got "
-            f"{local.dim()}"
-        )
-    import zlib
-
-    # int32 end-to-end: jax's default x64-truncation would silently fold
-    # int64 digests and break the cross-rank comparison.  Dims that don't
-    # fit int32 would wrap silently, so reject them up front.
-    if any(d > 0x7FFFFFFF for d in local.shape):
-        raise ValueError(
-            "allgather: tensor dims must fit in int32 for the cross-rank "
-            f"shape negotiation; got shape {tuple(local.shape)}"
-        )
-    digest = np.zeros((2 + _MAX_GATHER_NDIM,), np.int32)
-    digest[0] = local.dim()
-    # crc32, not hash(): Python's str hash is per-process randomized.
-    digest[1] = zlib.crc32(str(local.dtype).encode()) & 0x7FFFFFFF
-    digest[2:2 + local.dim()] = list(local.shape)
-    import jax
-
-    h = _eager.allgather_async(
-        _to_rank_major(torch.from_numpy(digest)),
-        name=None if name is None else f"{name}.shapes",
+    sizes = _eager.negotiate_gather_sizes(
+        tuple(local.shape), str(local.dtype), name
     )
-    all_digest = np.asarray(
-        jax.device_get(_eager.synchronize(h))
-    ).reshape(size(), 2 + _MAX_GATHER_NDIM)
-    for r in range(size()):
-        if all_digest[r, 0] != local.dim() or all_digest[r, 1] != digest[1]:
-            raise ValueError(
-                "allgather: per-rank tensors must share ndim and dtype; "
-                f"rank {r} disagrees ({all_digest[r, :2].tolist()} vs "
-                f"{digest[:2].tolist()})"
-            )
-        if list(all_digest[r, 3:2 + local.dim()]) != list(local.shape[1:]):
-            raise ValueError(
-                "allgather: per-rank tensors must agree on all dims except "
-                f"dim 0; rank {r} has trailing {all_digest[r, 3:2 + local.dim()].tolist()}"
-                f" vs local {list(local.shape[1:])}"
-            )
-    sizes = [int(all_digest[r, 2]) for r in range(size())]
     return local, sizes
 
 
@@ -389,9 +345,9 @@ def allgather_async(tensor, name=None) -> int:
                              dtype=local.dtype)
         padded[:local.shape[0]] = local
         local = padded
-    h = _eager.allgather_async(_to_rank_major(local), name=name)
-    if len(set(sizes)) > 1:
-        _attach_post(h, ragged=(pad, sizes))
+    # The engine slices the ragged concatenation itself (sizes=).
+    h = _eager.allgather_async(_to_rank_major(local), name=name,
+                               sizes=sizes)
     return _note_wire_dtype(h, tensor)
 
 
@@ -584,13 +540,6 @@ def synchronize(handle: int):
         out = _np_to_torch(local)
     else:
         out = _to_torch(raw)
-        rag = post.get("ragged")
-        if rag is not None:
-            pad, sizes = rag
-            out = torch.cat(
-                [out[r * pad:r * pad + s] for r, s in enumerate(sizes)],
-                dim=0,
-            )
     x64r = post.get("x64_reduce")
     if x64r is not None:
         op, want_dtype, shape = x64r

@@ -102,6 +102,13 @@ def main() -> None:
     # --- 4. value-level ops -------------------------------------------
     assert hvd.allreduce(float(me), name="scalar") == 0.5
     assert hvd.broadcast(float(me + 5), root_rank=1, name="bscalar") == 6.0
+    # Ragged allgather: rank r contributes r+1 rows (reference's
+    # unequal-first-dim form).
+    ragged = np.full((me + 1, 3), float(me), np.float32)
+    got = hvd.allgather(ragged, name="ragged")
+    want = np.concatenate([np.full((r + 1, 3), float(r), np.float32)
+                           for r in range(n)])
+    assert np.array_equal(got, want), (me, got)
 
     print("WORKER_OK " + json.dumps({
         "rank": me, "final_norm": float(np.linalg.norm(final)),
