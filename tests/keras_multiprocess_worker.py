@@ -12,7 +12,11 @@ Checks, in order:
 3. a 2-epoch ``fit`` on rank-DIFFERENT data keeps weights bit-identical
    across ranks (averaged grads + identical start = identical
    trajectory), and ``MetricAverageCallback`` rewrites epoch logs;
-4. value-level ``hvd.allreduce``/``broadcast`` round-trips.
+4. value-level ``hvd.allreduce``/``broadcast`` round-trips and the
+   ragged (unequal-first-dim) ``allgather``;
+5. fp16 wire compression through the optimizer actually rounds (values
+   chosen to be fp16-inexact, distinguishing compression-on from a
+   silently dropped ``compression=``).
 
 Prints ``WORKER_OK {json}`` on success.
 """
@@ -109,6 +113,31 @@ def main() -> None:
     want = np.concatenate([np.full((r + 1, 3), float(r), np.float32)
                            for r in range(n)])
     assert np.array_equal(got, want), (me, got)
+
+    # --- 5. fp16 wire compression through the optimizer ---------------
+    keras.utils.set_random_seed(77)
+    model3 = keras.Sequential(
+        [keras.layers.Dense(4, input_shape=(3,))]
+    )
+    opt3 = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=1.0),
+        compression=hvd.Compression.fp16,
+    )
+    opt3.build(model3.trainable_variables)
+    before3 = [v.numpy().copy() for v in model3.trainable_variables]
+    # 0.1/0.2 are NOT exactly representable in fp16: the compressed path
+    # must land near mean(0.1, 0.2) but measurably off the fp32-exact
+    # value — this distinguishes fp16-on-the-wire from a silently
+    # dropped compression= argument.
+    grads3 = [np.full(v.shape, 0.1 * (me + 1), np.float32)
+              for v in model3.trainable_variables]
+    opt3.apply(grads3, model3.trainable_variables)
+    exact = float((np.float32(0.1) + np.float32(0.2)) / np.float32(2))
+    for b, v in zip(before3, model3.trainable_variables):
+        delta = np.asarray(v.numpy()) - b
+        err = np.abs(delta + exact)
+        assert (err < 2e-3).all(), (me, delta.ravel()[:3])   # still ~mean
+        assert (err > 1e-5).all(), (me, delta.ravel()[:3])   # fp16 rounded
 
     print("WORKER_OK " + json.dumps({
         "rank": me, "final_norm": float(np.linalg.norm(final)),
