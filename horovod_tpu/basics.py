@@ -299,8 +299,11 @@ def init(
     if comm is not None:
         if devices is not None or mesh is not None:
             raise ValueError("init(): pass comm= or devices=/mesh=, not both")
+        import numbers
+
         if not (isinstance(comm, (list, tuple)) and comm and all(
-            isinstance(r, int) and not isinstance(r, bool) for r in comm
+            isinstance(r, numbers.Integral) and not isinstance(r, bool)
+            for r in comm
         )):
             raise TypeError(
                 "init(comm=...) takes a non-empty list of int ranks on "
@@ -309,6 +312,7 @@ def init(
                 "up); for a rank-subset world pass the rank list, for "
                 "subset COLLECTIVES on a full world use hvd.ProcessSet."
             )
+        comm = [int(r) for r in comm]  # numpy integers welcome
     with _state.lock:
         if _state.initialized:
             return

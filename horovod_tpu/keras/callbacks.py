@@ -24,6 +24,8 @@ reference:
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from horovod_tpu import basics as _basics
@@ -74,7 +76,14 @@ class BroadcastGlobalVariablesCallback(_KerasCallback):
 class MetricAverageCallback(_KerasCallback):
     """Allreduce-average numeric epoch metrics over ranks so rank-0 logs
     (and checkpoint/early-stop decisions) see global values
-    (reference _keras/callbacks.py:33-67)."""
+    (reference _keras/callbacks.py:33-67).
+
+    Metrics ride the float32 wire (TPUs have no 64-bit hardware path;
+    the same limitation the torch frontend documents under
+    ``HOROVOD_TPU_X64``): float64 metrics lose ~1e-7 relative precision
+    and integer metrics above 2**24 lose exactness.  For a
+    tighter-than-f32 early-stop criterion, average that metric yourself
+    through the torch frontend's x64 path."""
 
     def __init__(self, device: str = ""):
         super().__init__()
@@ -233,7 +242,10 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
 
     def on_epoch_end(self, epoch, logs=None):
         super().on_epoch_end(epoch, logs)
-        if epoch == self.end_epoch - 1 and self.verbose > 0 \
+        # ceil-1: warmup_epochs may be fractional (e.g. 2.5) — the ramp
+        # finishes during epoch ceil(end)-1, and an int == float-.5
+        # comparison would never fire the message.
+        if epoch == math.ceil(self.end_epoch) - 1 and self.verbose > 0 \
                 and _basics.rank() == 0:
             print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
                   f"warmup to {self._get_lr():g}.")

@@ -1109,7 +1109,16 @@ def allgather_async(tensors, name: str | None = None, *,
     true first dims (each ≤ pad) from
     :func:`negotiate_gather_sizes` — the engine then returns the ragged
     concatenation directly (one slicing implementation for the list,
-    torch, and keras frontends).  The list form negotiates its own."""
+    torch, and keras frontends).  The list form negotiates its own.
+
+    Cost note: the ragged slice/concat are device ops whose compiled
+    forms cache per (pad, sizes) composition, so a hot loop whose
+    per-rank sizes VARY every step pays a small fresh compile each step
+    (expensive over a remote-compile tunnel).  That trade favors the
+    actual ragged users — object/metric collectives, negotiated
+    per call anyway; a per-step ragged hot loop should pad to a fixed
+    shape instead (docs/tensor-fusion.md "Determinism and compile
+    churn")."""
     eng = _engine()
     if isinstance(tensors, (list, tuple)):
         if sizes is not None:

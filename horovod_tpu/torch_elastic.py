@@ -209,12 +209,14 @@ class TorchState(BaseState):
                                 # torn write never survives the zip
                                 # end-of-central-directory check, so a
                                 # structurally intact file means the
-                                # error is not truncation: fail every
-                                # rank via the outcome broadcast rather
-                                # than silently rolling back to an older
-                                # commit.
-                                if (isinstance(e, RuntimeError)
-                                        and zipfile.is_zipfile(path)):
+                                # error is not truncation — whatever the
+                                # deserializer raised (RuntimeError,
+                                # EOFError from an inner stream,
+                                # UnpicklingError from protocol drift):
+                                # fail every rank via the outcome
+                                # broadcast rather than silently rolling
+                                # back to an older commit.
+                                if zipfile.is_zipfile(path):
                                     raise
                                 # A torn/corrupt file from a mid-write
                                 # kill: walk on to the previous commit —

@@ -260,3 +260,15 @@ def test_broadcast_global_variables_requires_model_when_multiprocess():
     model = _model()
     model.compile(optimizer=keras.optimizers.SGD(), loss="mse")
     hvdk.broadcast_global_variables(0, model=model)  # no-op, must not raise
+
+
+def test_warmup_verbose_fires_for_fractional_epochs(capsys):
+    model = _model()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="mse")
+    x, y = _data(n=32)
+    warmup = hvdk.callbacks.LearningRateWarmupCallback(
+        warmup_epochs=1.5, steps_per_epoch=2, verbose=1)
+    model.fit(x, y, batch_size=16, epochs=2, verbose=0, callbacks=[warmup])
+    out = capsys.readouterr().out
+    assert "finished gradual learning rate warmup" in out

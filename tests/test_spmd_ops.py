@@ -202,8 +202,13 @@ def test_init_comm_rank_subset_and_rejections():
 
     import horovod_tpu as hvd
 
+    import numpy as _np
+
     hvd.shutdown()
     try:
+        hvd.init(comm=list(_np.arange(3)))   # numpy integers welcome
+        assert hvd.size() == 3
+        hvd.shutdown()
         hvd.init(comm=[0, 2, 5])
         assert hvd.size() == 3
         devs = hvd.mesh().devices.tolist()
