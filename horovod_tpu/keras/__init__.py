@@ -222,17 +222,20 @@ def broadcast_global_variables(root_rank: int, model=None) -> None:
 
 
 def _host_allreduce(prefix: str, compression, average: bool, arrays):
-    """Post every gradient async, then drain — the async window is what
-    lets the engine fuse small gradients into one collective (the
-    reference's tensor-fusion behavior, SURVEY.md §2.1 C5)."""
-    handles = [
-        _eager.allreduce_async(
-            _np_to_rank_major(np.asarray(a)), average=average,
-            name=f"{prefix}.grad_{i}", compression=compression,
-        )
-        for i, a in enumerate(arrays)
-    ]
-    return tuple(_from_device(_eager.synchronize(h)) for h in handles)
+    """One caller-delimited fusion group per gradient burst (the
+    reference's tensor-fusion behavior, SURVEY.md §2.1 C5).  The grouped
+    call — not individual asyncs — is what actually fuses here:
+    multi-controller fusion is restricted to caller-delimited groups
+    (timing-based bucketing would diverge across ranks,
+    docs/tensor-fusion.md), and the per-tensor host bridging between
+    individual posts spans cycle ticks anyway."""
+    outs = _eager.grouped_allreduce_eager(
+        [_np_to_rank_major(np.asarray(a)) for a in arrays],
+        average=average,
+        names=[f"{prefix}.grad_{i}" for i in range(len(arrays))],
+        compression=compression,
+    )
+    return tuple(_from_device(o) for o in outs)
 
 
 def _allreduce_gradients(grads: list, *, prefix: str, compression,

@@ -86,10 +86,21 @@ def main() -> None:
     rng = np.random.RandomState(7 + me)  # DIFFERENT data per rank
     x = rng.randn(32, 6).astype(np.float32)
     y = rng.randn(32, 2).astype(np.float32)
+    from horovod_tpu.ops.eager import engine_stats
+
+    fused_before_fit = engine_stats().get("tensors_fused", 0)
     hist = model2.fit(
         x, y, batch_size=8, epochs=2, shuffle=False, verbose=0,
         callbacks=[hvd.callbacks.MetricAverageCallback()],
     )
+    # The jitted-fit gradient path must ride Tensor Fusion: each step's
+    # io_callback issues ONE caller-delimited grouped allreduce of the 4
+    # grads (individual asyncs would not fuse in multi-controller mode).
+    # Delta from before fit: section 1's eager apply already fused.
+    stats = engine_stats()
+    assert stats.get("tensors_fused", 0) > fused_before_fit, (
+        fused_before_fit, stats)
+
     final = np.concatenate(
         [v.numpy().ravel() for v in model2.trainable_variables]
     )
