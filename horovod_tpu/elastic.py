@@ -83,7 +83,8 @@ def _own(leaf: Any) -> Any:
 class BaseState:
     """The interface :func:`run` keys on — any state object exposing
     commit / restore / sync (the JAX-native :class:`State` here, the
-    torch frontend's :class:`horovod_tpu.torch_elastic.TorchState`)."""
+    torch frontend's :class:`horovod_tpu.torch_elastic.TorchState`, the
+    keras frontend's :class:`horovod_tpu.keras_elastic.KerasState`)."""
 
     def commit(self) -> None:
         raise NotImplementedError
@@ -93,6 +94,73 @@ class BaseState:
 
     def sync(self) -> None:
         raise NotImplementedError
+
+
+def atomic_write(dst: str, write_fn: Callable[[Any], None]) -> None:
+    """tmp + fsync + rename: a renamed commit file is a COMPLETE file.
+    Without the fsync a power loss can persist the rename while payload
+    blocks are still zeroed — a structurally-valid-but-corrupt file the
+    restore walks' torn-write discrimination would then hard-fail on."""
+    with open(dst + ".tmp", "wb") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(dst + ".tmp", dst)
+
+
+def restore_newest_commit(ckpt_dir: str, suffix: str,
+                          read_file: Callable[[str], Any],
+                          load_local: Callable[[Any], None],
+                          is_root: bool,
+                          broadcast_obj: Callable[[Any], Any]):
+    """The shared durable-restore walk + outcome-agreement protocol
+    (used by TorchState and KerasState; the serializer is the only
+    per-frontend part).
+
+    Newest-first scan of ``step_<N>.<suffix>``.  A file that fails
+    ``zipfile.is_zipfile`` (both ``.pt`` and ``.npz`` are zips) is a
+    torn mid-write kill: walk on to the previous commit LOUDLY (later
+    commits renumber over the skipped step).  A structurally INTACT file
+    whose payload fails to deserialize is not truncation — whatever the
+    deserializer raised — so it hard-fails every rank instead of
+    silently rolling back.  Every root-side failure becomes an outcome
+    value agreed via ``broadcast_obj``; root always reaches that
+    broadcast, so a root-only raise can never strand non-root ranks in
+    the collective.  Returns the agreed outcome (None = no commit found,
+    "ok" = loaded on root, else an error string)."""
+    import re
+    import zipfile
+
+    outcome = None
+    if is_root:
+        try:
+            snap = None
+            if os.path.isdir(ckpt_dir):
+                steps = sorted(
+                    (int(m.group(1)) for m in (
+                        re.fullmatch(rf"step_(\d+)\.{re.escape(suffix)}", e)
+                        for e in os.listdir(ckpt_dir)) if m),
+                    reverse=True)
+                for s in steps:
+                    path = os.path.join(ckpt_dir, f"step_{s}.{suffix}")
+                    try:
+                        snap = read_file(path)
+                        break
+                    except Exception as e:
+                        if zipfile.is_zipfile(path):
+                            raise
+                        warnings.warn(
+                            f"elastic restore: skipping unreadable "
+                            f"checkpoint {path} ({type(e).__name__}: "
+                            f"{e}); falling back to the previous commit",
+                            stacklevel=2)
+                        continue
+            if snap is not None:
+                load_local(snap)
+                outcome = "ok"
+        except Exception as e:
+            outcome = f"{type(e).__name__}: {e}"
+    return broadcast_obj(outcome)
 
 
 class State(BaseState):

@@ -192,10 +192,11 @@ def broadcast_variables(variables: Sequence[Any], root_rank: int = 0) -> None:
         arr = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
         h = _eager.broadcast_async(_np_to_rank_major(arr), root_rank,
                                    name=f"keras.bcast.{i}")
-        handles.append((v, arr.dtype, h))
-    for v, dt, h in handles:
+        handles.append((v, arr, h))
+    for v, arr, h in handles:
         out = _from_device(_eager.synchronize(h))
-        v.assign(out.astype(dt, copy=False))
+        # reshape: a scalar variable's wire form is (1,), not ().
+        v.assign(out.reshape(arr.shape).astype(arr.dtype, copy=False))
 
 
 def broadcast_global_variables(root_rank: int, model=None) -> None:
@@ -415,11 +416,14 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
 
 
 from horovod_tpu.keras import callbacks  # noqa: E402,F401
+# hvd.elastic.KerasState / hvd.elastic.run — horovod's keras elastic
+# parity (Horovod 0.20+; see horovod_tpu/keras_elastic.py).
+from horovod_tpu import keras_elastic as elastic  # noqa: E402,F401
 
 __all__ = [
     "init", "shutdown", "size", "local_size", "rank", "local_rank",
     "cross_size", "cross_rank", "is_initialized", "mpi_threads_supported",
     "Compression", "DistributedOptimizer", "allreduce", "allgather",
     "broadcast", "broadcast_variables", "broadcast_global_variables",
-    "load_model", "callbacks",
+    "load_model", "callbacks", "elastic",
 ]

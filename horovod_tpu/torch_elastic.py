@@ -37,10 +37,6 @@ from __future__ import annotations
 
 import copy
 import os
-import pickle
-import re
-import warnings
-import zipfile
 from typing import Any
 
 from horovod_tpu import elastic as _elastic
@@ -123,16 +119,7 @@ class TorchState(BaseState):
         if ckpt_dir and _hvdt().rank() == 0:
             os.makedirs(ckpt_dir, exist_ok=True)
             dst = os.path.join(ckpt_dir, f"step_{self.commit_step}.pt")
-            # fsync BEFORE the rename: without it a power loss can
-            # persist the rename while payload blocks are still zeroed —
-            # a structurally-valid-but-corrupt file the restore walk's
-            # is_zipfile torn-write discrimination would then hard-fail
-            # on.  With the fsync, a renamed file is a complete file.
-            with open(dst + ".tmp", "wb") as f:
-                torch.save(snap, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(dst + ".tmp", dst)
+            _elastic.atomic_write(dst, lambda f: torch.save(snap, f))
 
     def _load_local(self, snap: dict) -> None:
         if self.model is not None and snap.get("model") is not None:
@@ -178,63 +165,18 @@ class TorchState(BaseState):
         hvdt = _hvdt()
         ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
         if ckpt_dir:
-            # EVERY root-side failure — walking the dir, loading a file,
-            # applying the state_dicts — is converted to an outcome value
-            # and agreed via the broadcast below.  Root must reach that
-            # broadcast no matter what: non-root ranks enter it
-            # unconditionally, so a root-only raise here would strand
-            # them in the collective forever (the hang checkpoint.py's
-            # restore_checkpoint guards against the same way).
-            outcome = None            # None = no commit; "ok"; or error str
-            if hvdt.rank() == 0:
-                try:
-                    snap = None
-                    if os.path.isdir(ckpt_dir):
-                        steps = sorted(
-                            (int(m.group(1)) for m in (
-                                re.fullmatch(r"step_(\d+)\.pt", e)
-                                for e in os.listdir(ckpt_dir)) if m),
-                            reverse=True)
-                        for s in steps:
-                            path = os.path.join(ckpt_dir, f"step_{s}.pt")
-                            try:
-                                snap = torch.load(path, map_location="cpu",
-                                                  weights_only=False)
-                                break
-                            except (RuntimeError, EOFError,
-                                    zipfile.BadZipFile,
-                                    pickle.UnpicklingError) as e:
-                                # torch.load also raises RuntimeError for
-                                # ENVIRONMENTAL failures (OOM, mmap).  A
-                                # torn write never survives the zip
-                                # end-of-central-directory check, so a
-                                # structurally intact file means the
-                                # error is not truncation — whatever the
-                                # deserializer raised (RuntimeError,
-                                # EOFError from an inner stream,
-                                # UnpicklingError from protocol drift):
-                                # fail every rank via the outcome
-                                # broadcast rather than silently rolling
-                                # back to an older commit.
-                                if zipfile.is_zipfile(path):
-                                    raise
-                                # A torn/corrupt file from a mid-write
-                                # kill: walk on to the previous commit —
-                                # LOUDLY, because later commits renumber
-                                # over the skipped step.
-                                warnings.warn(
-                                    f"elastic restore: skipping "
-                                    f"unreadable checkpoint {path} "
-                                    f"({type(e).__name__}: {e}); falling "
-                                    f"back to the previous commit",
-                                    stacklevel=2)
-                                continue
-                    if snap is not None:
-                        self._load_local(snap)
-                        outcome = "ok"
-                except Exception as e:
-                    outcome = f"{type(e).__name__}: {e}"
-            outcome = hvdt.broadcast_object(outcome, root_rank=0)
+            # The walk, the torn-vs-intact discrimination, and the
+            # outcome-agreement protocol live in
+            # elastic.restore_newest_commit (shared with KerasState).
+            outcome = _elastic.restore_newest_commit(
+                ckpt_dir, "pt",
+                read_file=lambda p: torch.load(p, map_location="cpu",
+                                               weights_only=False),
+                load_local=self._load_local,
+                is_root=hvdt.rank() == 0,
+                broadcast_obj=lambda o: hvdt.broadcast_object(
+                    o, root_rank=0),
+            )
             if outcome == "ok":
                 self.sync()           # root's loaded values fan out
                 return

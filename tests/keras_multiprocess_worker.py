@@ -150,6 +150,19 @@ def main() -> None:
         assert (err < 2e-3).all(), (me, delta.ravel()[:3])   # still ~mean
         assert (err > 1e-5).all(), (me, delta.ravel()[:3])   # fp16 rounded
 
+    # --- 6. KerasState sync: divergent state adopts rank 0's ----------
+    keras.utils.set_random_seed(500 + me)   # diverge weights again
+    model4 = keras.Sequential([keras.layers.Input((3,)),
+                               keras.layers.Dense(2)])
+    model4.compile(optimizer=hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1)), loss="mse")
+    state = hvd.elastic.KerasState(model4, epoch=10 + me)
+    state.restore()              # no commit anywhere -> plain sync
+    assert state.epoch == 10, (me, state.epoch)
+    w_root = hvd.broadcast(model4.layers[0].kernel.numpy(), root_rank=0,
+                           name="ks.w0")
+    assert np.array_equal(model4.layers[0].kernel.numpy(), w_root), me
+
     print("WORKER_OK " + json.dumps({
         "rank": me, "final_norm": float(np.linalg.norm(final)),
         "loss0": float(losses[0]),

@@ -272,3 +272,110 @@ def test_warmup_verbose_fires_for_fractional_epochs(capsys):
     model.fit(x, y, batch_size=16, epochs=2, verbose=0, callbacks=[warmup])
     out = capsys.readouterr().out
     assert "finished gradual learning rate warmup" in out
+
+
+def _fit_briefly(model):
+    x, y = _data(n=32)
+    model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+
+
+def test_keras_state_memory_round_trip():
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    _fit_briefly(model)
+    state = hvdk.elastic.KerasState(model, epoch=3)
+    state.commit()
+    w0 = [w.copy() for w in model.get_weights()]
+    o0 = [np.asarray(v.numpy()).copy()
+          for v in model.optimizer.variables]
+
+    _fit_briefly(model)          # mutate weights + slots
+    state.epoch = 7
+    state.restore()              # in-memory commit wins
+    assert state.epoch == 3
+    for a, b in zip(w0, model.get_weights()):
+        assert np.array_equal(a, np.asarray(b))
+    for a, v in zip(o0, model.optimizer.variables):
+        assert np.array_equal(a, np.asarray(v.numpy()))
+
+    with pytest.raises(AttributeError, match="unknown state field"):
+        state.undeclared = 1
+
+
+def test_keras_state_durable_restore_and_torn_file(tmp_path):
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    _fit_briefly(model)
+    state = hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=1)
+    state.commit()
+    good = [w.copy() for w in model.get_weights()]
+
+    _fit_briefly(model)
+    state.epoch = 2
+    state.commit()               # step_2.npz, the newest commit
+
+    # Torn write of the newest commit: truncate so it is not a zip.
+    newest = tmp_path / "step_2.npz"
+    newest.write_bytes(newest.read_bytes()[:40])
+
+    # A FRESH state (relaunch) must fall back to step_1 with a warning.
+    model.set_weights([np.zeros_like(w) for w in good])
+    fresh = hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=0)
+    with pytest.warns(UserWarning, match="falling back"):
+        fresh.restore()
+    assert fresh.epoch == 1
+    assert fresh.commit_step == 1
+    for a, b in zip(good, model.get_weights()):
+        assert np.array_equal(a, np.asarray(b))
+
+
+def test_keras_state_intact_but_corrupt_hard_fails(tmp_path):
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1)), loss="mse")
+    _fit_briefly(model)
+    state = hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=1)
+    state.commit()
+
+    # Structurally valid zip whose payload is NOT a commit: silent
+    # rollback would renumber later commits, so restore must hard-fail.
+    import zipfile as zf
+
+    with zf.ZipFile(tmp_path / "step_2.npz", "w") as z:
+        z.writestr("meta.npy", b"not numpy data")
+    fresh = hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=0)
+    with pytest.raises(RuntimeError, match="restore failed"):
+        fresh.restore()
+
+
+def test_keras_state_restores_slots_into_unbuilt_optimizer(tmp_path):
+    """The relaunch flow: a fresh process compiles the model and calls
+    restore() BEFORE any fit, so the optimizer is unbuilt — committed
+    slot state must be restored into a freshly BUILT optimizer, not
+    silently dropped (momentum resuming from zero is an invisible
+    loss)."""
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    _fit_briefly(model)
+    state = hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=5)
+    state.commit()
+    slots = [np.asarray(v.numpy()).copy()
+             for v in model.optimizer.variables]
+    assert any(np.abs(s).max() > 0 for s in slots)
+
+    # A "relaunched" model: same architecture, compiled, NEVER fit.
+    model2 = _model(seed=9)
+    model2.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    assert not model2.optimizer.built
+    fresh = hvdk.elastic.KerasState(model2, ckpt_dir=str(tmp_path), epoch=0)
+    fresh.restore()
+    assert fresh.epoch == 5
+    assert model2.optimizer.built
+    for a, v in zip(slots, model2.optimizer.variables):
+        assert np.array_equal(a, np.asarray(v.numpy()))
+    for a, b in zip(model.get_weights(), model2.get_weights()):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
