@@ -1,0 +1,81 @@
+"""Fault-tolerant Keras-3 MNIST with ``hvd.elastic.KerasState`` — the
+keras-frontend counterpart of examples/jax_elastic.py and
+examples/pytorch_elastic.py (Horovod grew ``KerasState`` in 0.20; the
+0.15.1 reference has no elastic at all).
+
+The pattern: declare the model + progress in ``KerasState``, wrap the
+epoch loop in ``@hvd.elastic.run`` (restores the newest durable commit —
+weights, optimizer slots, epoch — on every (re)start), and commit at
+epoch boundaries — advance-then-commit, so a restore never replays work
+the commit already covers.
+
+One process per device under the supervising launcher:
+
+    KERAS_BACKEND=jax python -m horovod_tpu.launch --nproc 2 --cpu \\
+        --restarts 3 -- python examples/keras_elastic.py --epochs 4
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+from horovod_tpu.data import shard_indices, synthetic_mnist
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--samples", type=int, default=2048)
+    p.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_keras_elastic")
+    args = p.parse_args()
+
+    hvd.init()
+    keras.utils.set_random_seed(42)
+    model = keras.Sequential([
+        keras.layers.Input((28 * 28,)),
+        keras.layers.Dense(128, activation="tanh"),
+        keras.layers.Dense(10),
+    ])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(args.lr * hvd.size(), momentum=0.5)
+        ),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+
+    state = hvd.elastic.KerasState(model, ckpt_dir=args.ckpt_dir, epoch=0)
+
+    images, labels = synthetic_mnist(args.samples)
+    images = np.asarray(images, np.float32).reshape(len(images), -1)
+    labels = np.asarray(labels, np.int32)
+
+    @hvd.elastic.run
+    def train(state):
+        # run() already restored the newest commit and synced every rank
+        # (weights, optimizer slots, epoch).
+        last = None                 # a resume may cover every epoch
+        while state.epoch < args.epochs:
+            idx = shard_indices(len(images), hvd.rank(), hvd.size(),
+                                epoch=state.epoch, drop_last=True)
+            hist = model.fit(images[idx], labels[idx],
+                             batch_size=args.batch_size, shuffle=False,
+                             epochs=1, verbose=0)
+            last = float(hist.history["loss"][-1])
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {last:.4f}", flush=True)
+            state.epoch += 1
+            state.commit()          # epoch boundary is durable
+        return last
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

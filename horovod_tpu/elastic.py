@@ -96,6 +96,117 @@ class BaseState:
         raise NotImplementedError
 
 
+class LiveObjectState(BaseState):
+    """Shared machinery for elastic state over LIVE framework objects
+    (:class:`~horovod_tpu.torch_elastic.TorchState`,
+    :class:`~horovod_tpu.keras_elastic.KerasState`): declared scalar
+    fields with completeness guards, in-memory + durable rank-0 commits,
+    and the restore order (durable walk → mem commit → plain sync).
+    One copy of the protocol; subclasses supply the serializer and the
+    object-slot specifics via the hooks below."""
+
+    _reserved: tuple = ()       # object-slot attribute names
+    _suffix: str = "bin"        # step_<N>.<suffix> commit files
+
+    def _init_live(self, ckpt_dir, scalars: dict) -> None:
+        for k in scalars:
+            if k.startswith("_") or k in self._reserved:
+                raise ValueError(f"reserved field name: {k!r}")
+        object.__setattr__(self, "_scalars", dict(scalars))
+        object.__setattr__(self, "_ckpt_dir",
+                           os.path.abspath(ckpt_dir) if ckpt_dir else None)
+        object.__setattr__(self, "_mem_commit", None)
+        object.__setattr__(self, "_commit_step", 0)
+
+    def __getattr__(self, name: str):
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value) -> None:
+        if name in type(self)._reserved or name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        scalars = object.__getattribute__(self, "_scalars")
+        if name in scalars:
+            scalars[name] = value
+        else:
+            raise AttributeError(
+                f"unknown state field {name!r}; declare every scalar in "
+                f"{type(self).__name__}(...) so commits stay complete"
+            )
+
+    @property
+    def commit_step(self) -> int:
+        return object.__getattribute__(self, "_commit_step")
+
+    def _adopt_scalars(self, incoming: dict) -> None:
+        # Only DECLARED fields are adopted (same contract as State._adopt):
+        # a commit from an older code revision must not inject undeclared
+        # keys past the __setattr__ completeness guard.
+        scalars = object.__getattribute__(self, "_scalars")
+        for k in scalars:
+            if k in incoming:
+                scalars[k] = incoming[k]
+
+    # -- subclass hooks ---------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        raise NotImplementedError
+
+    def _load_local(self, snap) -> None:
+        raise NotImplementedError
+
+    def _write_file(self, dst: str, snap) -> None:
+        raise NotImplementedError
+
+    def _read_file(self, path: str):
+        raise NotImplementedError
+
+    def _rank0(self) -> bool:
+        raise NotImplementedError
+
+    def _broadcast_obj(self, obj):
+        raise NotImplementedError
+
+    # -- the shared protocol ----------------------------------------------
+
+    def commit(self) -> None:
+        """Snapshot in host memory; rank 0 additionally writes
+        ``step_N.<suffix>`` atomically (tmp + fsync + rename)."""
+        object.__setattr__(self, "_commit_step", self.commit_step + 1)
+        snap = self._snapshot()
+        object.__setattr__(self, "_mem_commit", snap)
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir and self._rank0():
+            os.makedirs(ckpt_dir, exist_ok=True)
+            self._write_file(
+                os.path.join(ckpt_dir,
+                             f"step_{self.commit_step}.{self._suffix}"),
+                snap)
+
+    def restore(self) -> None:
+        """Adopt the newest commit: durable ``step_N.<suffix>`` (root
+        reads, everyone receives via sync) → in-memory snapshot → plain
+        sync of the initial values."""
+        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
+        if ckpt_dir:
+            outcome = restore_newest_commit(
+                ckpt_dir, self._suffix, self._read_file, self._load_local,
+                self._rank0(), self._broadcast_obj)
+            if outcome == "ok":
+                self.sync()         # root's loaded values fan out
+                return
+            if outcome is not None:
+                raise RuntimeError(
+                    f"elastic restore failed on root: {outcome}")
+        mem = object.__getattribute__(self, "_mem_commit")
+        if mem is not None:
+            self._load_local(mem)
+        self.sync()
+
+
 def atomic_write(dst: str, write_fn: Callable[[Any], None]) -> None:
     """tmp + fsync + rename: a renamed commit file is a COMPLETE file.
     Without the fsync a power loss can persist the rename while payload

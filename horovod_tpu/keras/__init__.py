@@ -192,11 +192,13 @@ def broadcast_variables(variables: Sequence[Any], root_rank: int = 0) -> None:
         arr = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
         h = _eager.broadcast_async(_np_to_rank_major(arr), root_rank,
                                    name=f"keras.bcast.{i}")
-        handles.append((v, arr, h))
-    for v, arr, h in handles:
+        # keep shape/dtype only, not the array — holding every host copy
+        # until the drain would double a large model's host footprint
+        handles.append((v, arr.shape, arr.dtype, h))
+    for v, shape, dtype, h in handles:
         out = _from_device(_eager.synchronize(h))
         # reshape: a scalar variable's wire form is (1,), not ().
-        v.assign(out.reshape(arr.shape).astype(arr.dtype, copy=False))
+        v.assign(out.reshape(shape).astype(dtype, copy=False))
 
 
 def broadcast_global_variables(root_rank: int, model=None) -> None:

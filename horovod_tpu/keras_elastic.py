@@ -59,8 +59,14 @@ def _hvdk():
     return hvdk
 
 
-class KerasState(BaseState):
-    """Elastic state over a live keras model + scalar progress fields."""
+class KerasState(_elastic.LiveObjectState):
+    """Elastic state over a live keras model + scalar progress fields.
+    The commit/restore protocol lives in
+    :class:`horovod_tpu.elastic.LiveObjectState`; this class supplies
+    the npz serializer and the keras model slot."""
+
+    _reserved = ("model",)
+    _suffix = "npz"
 
     def __init__(self, model: Any = None, *, ckpt_dir: str | None = None,
                  **scalars: Any) -> None:
@@ -68,38 +74,16 @@ class KerasState(BaseState):
             raise ValueError(
                 "KerasState needs a model or at least one scalar field"
             )
-        for k in scalars:
-            if k.startswith("_") or k == "model":
-                raise ValueError(f"reserved field name: {k!r}")
         object.__setattr__(self, "model", model)
-        object.__setattr__(self, "_scalars", dict(scalars))
-        object.__setattr__(self, "_ckpt_dir",
-                           os.path.abspath(ckpt_dir) if ckpt_dir else None)
-        object.__setattr__(self, "_mem_commit", None)
-        object.__setattr__(self, "_commit_step", 0)
+        self._init_live(ckpt_dir, scalars)
 
-    def __getattr__(self, name: str) -> Any:
-        scalars = object.__getattribute__(self, "_scalars")
-        if name in scalars:
-            return scalars[name]
-        raise AttributeError(name)
+    def _rank0(self) -> bool:
+        return _hvdk().rank() == 0
 
-    def __setattr__(self, name: str, value: Any) -> None:
-        if name == "model" or name.startswith("_"):
-            object.__setattr__(self, name, value)
-            return
-        scalars = object.__getattribute__(self, "_scalars")
-        if name in scalars:
-            scalars[name] = value
-        else:
-            raise AttributeError(
-                f"unknown state field {name!r}; declare every scalar in "
-                f"KerasState(...) so commits stay complete"
-            )
+    def _broadcast_obj(self, obj: Any) -> Any:
+        import horovod_tpu as hvd
 
-    @property
-    def commit_step(self) -> int:
-        return object.__getattribute__(self, "_commit_step")
+        return hvd.broadcast_object(obj, root_rank=0)
 
     # -- snapshot plumbing ------------------------------------------------
 
@@ -144,6 +128,15 @@ class KerasState(BaseState):
         opt_vars = snap.get("opt_vars")
         opt = (self._ensure_built_optimizer() if opt_vars is not None
                else self._optimizer())
+        if opt_vars is not None and opt is None and self.model is not None:
+            # The commit carries slot state but the live model has no
+            # optimizer (restore() before compile()): silently dropping
+            # the moments would be the invisible-loss failure the
+            # hard-fail-on-drift contract exists to prevent.
+            raise ValueError(
+                "commit contains optimizer slot state but the model has "
+                "no optimizer — compile() the model before restore()"
+            )
         if opt is not None and opt_vars is not None:
             if len(opt_vars) != len(opt.variables):
                 raise ValueError(
@@ -157,43 +150,26 @@ class KerasState(BaseState):
         object.__setattr__(self, "_commit_step",
                            int(snap.get("commit_step", self.commit_step)))
 
-    def _adopt_scalars(self, incoming: dict) -> None:
-        # Only DECLARED fields are adopted (same contract as State._adopt
-        # and TorchState._adopt_scalars).
-        scalars = object.__getattribute__(self, "_scalars")
-        for k in scalars:
-            if k in incoming:
-                scalars[k] = incoming[k]
-
     # -- commit / sync / restore -----------------------------------------
 
-    def commit(self) -> None:
-        """Snapshot in host memory; rank 0 additionally writes
-        ``step_N.npz`` atomically (tmp + fsync + rename)."""
-        object.__setattr__(self, "_commit_step", self.commit_step + 1)
-        snap = self._snapshot()
-        object.__setattr__(self, "_mem_commit", snap)
-        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
-        if ckpt_dir and _hvdk().rank() == 0:
-            os.makedirs(ckpt_dir, exist_ok=True)
-            dst = os.path.join(ckpt_dir, f"step_{self.commit_step}.npz")
-            arrays = {}
-            for i, w in enumerate(snap["weights"] or []):
-                arrays[f"w_{i}"] = w
-            for i, v in enumerate(snap["opt_vars"] or []):
-                arrays[f"o_{i}"] = v
-            arrays["meta"] = np.frombuffer(pickle.dumps({
-                "n_w": len(snap["weights"] or []),
-                "n_o": len(snap["opt_vars"] or []),
-                "has_w": snap["weights"] is not None,
-                "has_o": snap["opt_vars"] is not None,
-                "scalars": snap["scalars"],
-                "commit_step": snap["commit_step"],
-            }), np.uint8)
-            _elastic.atomic_write(dst, lambda f: np.savez(f, **arrays))
+    def _write_file(self, dst: str, snap: dict) -> None:
+        arrays = {}
+        for i, w in enumerate(snap["weights"] or []):
+            arrays[f"w_{i}"] = w
+        for i, v in enumerate(snap["opt_vars"] or []):
+            arrays[f"o_{i}"] = v
+        arrays["meta"] = np.frombuffer(pickle.dumps({
+            "n_w": len(snap["weights"] or []),
+            "n_o": len(snap["opt_vars"] or []),
+            "has_w": snap["weights"] is not None,
+            "has_o": snap["opt_vars"] is not None,
+            "scalars": snap["scalars"],
+            "commit_step": snap["commit_step"],
+        }), np.uint8)
+        _elastic.atomic_write(dst, lambda f: np.savez(f, **arrays))
 
     @staticmethod
-    def _read_npz(path: str) -> dict:
+    def _read_file(path: str) -> dict:
         with np.load(path, allow_pickle=False) as z:
             meta = pickle.loads(bytes(bytearray(z["meta"])))
             return {
@@ -228,32 +204,4 @@ class KerasState(BaseState):
         object.__setattr__(self, "_commit_step",
                            int(agreed["commit_step"]))
 
-    def restore(self) -> None:
-        """Adopt the newest commit: durable ``step_N.npz`` (root reads,
-        everyone receives via sync) → in-memory snapshot → plain sync of
-        the initial values."""
-        import horovod_tpu as hvd
-
-        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
-        if ckpt_dir:
-            # The walk, the torn-vs-intact discrimination, and the
-            # outcome-agreement protocol live in
-            # elastic.restore_newest_commit (shared with TorchState).
-            outcome = _elastic.restore_newest_commit(
-                ckpt_dir, "npz",
-                read_file=self._read_npz,
-                load_local=self._load_local,
-                is_root=_hvdk().rank() == 0,
-                broadcast_obj=lambda o: hvd.broadcast_object(
-                    o, root_rank=0),
-            )
-            if outcome == "ok":
-                self.sync()       # root's loaded values fan out
-                return
-            if outcome is not None:
-                raise RuntimeError(
-                    f"elastic restore failed on root: {outcome}")
-        mem = object.__getattribute__(self, "_mem_commit")
-        if mem is not None:
-            self._load_local(mem)
-        self.sync()
+    # commit()/restore() come from LiveObjectState (one protocol copy).

@@ -56,46 +56,40 @@ def _hvdt():
     return hvdt
 
 
-class TorchState(BaseState):
-    """Elastic state over live torch objects + scalar progress fields."""
+class TorchState(_elastic.LiveObjectState):
+    """Elastic state over live torch objects + scalar progress fields.
+    The commit/restore protocol (scalar guards, atomic rank-0 writes,
+    durable walk, outcome agreement) lives in
+    :class:`horovod_tpu.elastic.LiveObjectState`; this class supplies
+    the torch serializer and the model/optimizer slots."""
+
+    _reserved = ("model", "optimizer")
+    _suffix = "pt"
 
     def __init__(self, model: Any = None, optimizer: Any = None, *,
                  ckpt_dir: str | None = None, **scalars: Any) -> None:
         if model is None and optimizer is None and not scalars:
             raise ValueError("TorchState needs a model, an optimizer, or "
                              "at least one scalar field")
-        for k in scalars:
-            if k.startswith("_") or k in ("model", "optimizer"):
-                raise ValueError(f"reserved field name: {k!r}")
         object.__setattr__(self, "model", model)
         object.__setattr__(self, "optimizer", optimizer)
-        object.__setattr__(self, "_scalars", dict(scalars))
-        object.__setattr__(self, "_ckpt_dir",
-                           os.path.abspath(ckpt_dir) if ckpt_dir else None)
-        object.__setattr__(self, "_mem_commit", None)
-        object.__setattr__(self, "_commit_step", 0)
+        self._init_live(ckpt_dir, scalars)
 
-    def __getattr__(self, name: str) -> Any:
-        scalars = object.__getattribute__(self, "_scalars")
-        if name in scalars:
-            return scalars[name]
-        raise AttributeError(name)
+    def _rank0(self) -> bool:
+        return _hvdt().rank() == 0
 
-    def __setattr__(self, name: str, value: Any) -> None:
-        if name in ("model", "optimizer") or name.startswith("_"):
-            object.__setattr__(self, name, value)
-            return
-        scalars = object.__getattribute__(self, "_scalars")
-        if name in scalars:
-            scalars[name] = value
-        else:
-            raise AttributeError(
-                f"unknown state field {name!r}; declare every scalar in "
-                f"TorchState(...) so commits stay complete")
+    def _broadcast_obj(self, obj: Any) -> Any:
+        return _hvdt().broadcast_object(obj, root_rank=0)
 
-    @property
-    def commit_step(self) -> int:
-        return object.__getattribute__(self, "_commit_step")
+    def _write_file(self, dst: str, snap: dict) -> None:
+        import torch
+
+        _elastic.atomic_write(dst, lambda f: torch.save(snap, f))
+
+    def _read_file(self, path: str) -> dict:
+        import torch
+
+        return torch.load(path, map_location="cpu", weights_only=False)
 
     def _snapshot(self) -> dict:
         return {
@@ -107,20 +101,6 @@ class TorchState(BaseState):
             "commit_step": self.commit_step,
         }
 
-    def commit(self) -> None:
-        """Snapshot in host memory; rank 0 additionally ``torch.save``s
-        ``step_N.pt`` atomically (tmp + rename — no torn files)."""
-        import torch
-
-        object.__setattr__(self, "_commit_step", self.commit_step + 1)
-        snap = self._snapshot()
-        object.__setattr__(self, "_mem_commit", snap)
-        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
-        if ckpt_dir and _hvdt().rank() == 0:
-            os.makedirs(ckpt_dir, exist_ok=True)
-            dst = os.path.join(ckpt_dir, f"step_{self.commit_step}.pt")
-            _elastic.atomic_write(dst, lambda f: torch.save(snap, f))
-
     def _load_local(self, snap: dict) -> None:
         if self.model is not None and snap.get("model") is not None:
             self.model.load_state_dict(snap["model"])
@@ -129,17 +109,6 @@ class TorchState(BaseState):
         self._adopt_scalars(snap["scalars"])
         object.__setattr__(self, "_commit_step",
                            int(snap.get("commit_step", self.commit_step)))
-
-    def _adopt_scalars(self, incoming: dict) -> None:
-        # Only DECLARED fields are adopted (same contract as the JAX-side
-        # State._adopt): a commit from an older code revision must not
-        # inject undeclared keys past the __setattr__ completeness guard,
-        # nor silently leave a renamed field at its initial value without
-        # the reader noticing the mismatch in what restore() returns.
-        scalars = object.__getattribute__(self, "_scalars")
-        for k in scalars:
-            if k in incoming:
-                scalars[k] = incoming[k]
 
     def sync(self) -> None:
         """Fan the root's current state out to every rank (the reference
@@ -156,34 +125,4 @@ class TorchState(BaseState):
         object.__setattr__(self, "_commit_step",
                            int(agreed["commit_step"]))
 
-    def restore(self) -> None:
-        """Adopt the newest commit: durable ``step_N.pt`` (root reads,
-        everyone receives via sync) → in-memory snapshot → plain sync of
-        the initial values."""
-        import torch
-
-        hvdt = _hvdt()
-        ckpt_dir = object.__getattribute__(self, "_ckpt_dir")
-        if ckpt_dir:
-            # The walk, the torn-vs-intact discrimination, and the
-            # outcome-agreement protocol live in
-            # elastic.restore_newest_commit (shared with KerasState).
-            outcome = _elastic.restore_newest_commit(
-                ckpt_dir, "pt",
-                read_file=lambda p: torch.load(p, map_location="cpu",
-                                               weights_only=False),
-                load_local=self._load_local,
-                is_root=hvdt.rank() == 0,
-                broadcast_obj=lambda o: hvdt.broadcast_object(
-                    o, root_rank=0),
-            )
-            if outcome == "ok":
-                self.sync()           # root's loaded values fan out
-                return
-            if outcome is not None:
-                raise RuntimeError(
-                    f"elastic restore failed on root: {outcome}")
-        mem = object.__getattribute__(self, "_mem_commit")
-        if mem is not None:
-            self._load_local(mem)
-        self.sync()
+    # commit()/restore() come from LiveObjectState (one protocol copy).

@@ -379,3 +379,18 @@ def test_keras_state_restores_slots_into_unbuilt_optimizer(tmp_path):
         assert np.array_equal(a, np.asarray(v.numpy()))
     for a, b in zip(model.get_weights(), model2.get_weights()):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_keras_state_rejects_restore_before_compile(tmp_path):
+    """opt_vars in the commit + an uncompiled model at restore: hard-fail
+    (silently dropping the moments is the invisible-loss case)."""
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    _fit_briefly(model)
+    hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=1).commit()
+
+    bare = _model(seed=4)            # never compiled
+    fresh = hvdk.elastic.KerasState(bare, ckpt_dir=str(tmp_path), epoch=0)
+    with pytest.raises(RuntimeError, match="compile"):
+        fresh.restore()

@@ -577,3 +577,34 @@ def test_keras_frontend_two_ranks():
     )
     for i, out in enumerate(outs):
         assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
+def test_keras_elastic_example_via_launcher(tmp_path):
+    """The keras-frontend elastic example: run once to completion, then
+    re-launch against the same commit dir — the second gang restores
+    epoch==epochs and trains nothing (resume-as-no-op through
+    KerasState), completing the elastic-triple's launcher drills."""
+    pytest.importorskip("keras")
+    env = dict(os.environ)
+    env["HOROVOD_TPU_NATIVE_CONTROLLER"] = "on"
+    env["KERAS_BACKEND"] = "jax"
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    cmd = [sys.executable, "-m", "horovod_tpu.launch", "--nproc", "2",
+           "--cpu", "--restarts", "1", "--", sys.executable,
+           os.path.join(os.path.dirname(HERE), "examples",
+                        "keras_elastic.py"),
+           "--epochs", "1", "--samples", "256", "--batch-size", "16",
+           "--ckpt-dir", str(tmp_path / "ck")]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=300, cwd=os.path.dirname(HERE))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "epoch 0: loss" in r.stdout
+    assert (tmp_path / "ck" / "step_1.npz").exists()
+
+    r2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300, cwd=os.path.dirname(HERE))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "epoch 0: loss" not in r2.stdout     # resumed past the end
