@@ -217,6 +217,18 @@ def atomic_write(dst: str, write_fn: Callable[[Any], None]) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(dst + ".tmp", dst)
+    # The rename itself is only durable once the DIRECTORY entry is on
+    # disk; without this a power loss can lose the (fully written,
+    # fsynced) newest commit entirely and a resume silently replays work
+    # the caller treated as committed.
+    try:
+        dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
+    except OSError:
+        return     # platform without directory fds: best effort
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def restore_newest_commit(ckpt_dir: str, suffix: str,
@@ -484,7 +496,8 @@ def run(fn: Callable) -> Callable:
     def wrapper(state: BaseState, *args: Any, **kwargs: Any) -> Any:
         if not isinstance(state, BaseState):
             raise TypeError("first argument to an elastic.run function "
-                            "must be an elastic.State (or TorchState)")
+                            "must be an elastic.State (or TorchState / "
+                            "KerasState)")
         basics._require_init()
         retries = int(os.environ.get("HOROVOD_TPU_ELASTIC_RETRIES", "3"))
         attempt = 0

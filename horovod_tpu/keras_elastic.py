@@ -105,6 +105,13 @@ class KerasState(_elastic.LiveObjectState):
         opt = getattr(m, "optimizer", None) if m is not None else None
         if opt is None:
             return None
+        if not getattr(m, "built", True):
+            # A deferred-build model (no Input layer, never called) has
+            # ZERO trainable variables right now — building the optimizer
+            # over them would permanently pin it to 0 slots and crash the
+            # first fit.  Leave both unbuilt; _load_local raises its own
+            # clear error if a commit actually needs them.
+            return None
         if not getattr(opt, "built", False):
             opt.build(m.trainable_variables)
         return opt
@@ -123,7 +130,25 @@ class KerasState(_elastic.LiveObjectState):
         }
 
     def _load_local(self, snap: dict) -> None:
+        has_payload = (snap.get("weights") is not None
+                       or snap.get("opt_vars") is not None)
+        if has_payload and self.model is None:
+            # Silently restoring only the scalars from a commit that
+            # carries weights/slots is the invisible-loss case: training
+            # would proceed from fresh random weights with the epoch
+            # counter claiming otherwise.
+            raise ValueError(
+                "commit contains model state but this KerasState has no "
+                "model — pass the model to KerasState(...) before "
+                "restore()"
+            )
         if self.model is not None and snap.get("weights") is not None:
+            if not getattr(self.model, "built", True):
+                raise ValueError(
+                    "commit contains weights but the model is unbuilt — "
+                    "build it (add an Input layer, call build(), or run "
+                    "one batch) before restore()"
+                )
             self.model.set_weights(snap["weights"])
         opt_vars = snap.get("opt_vars")
         opt = (self._ensure_built_optimizer() if opt_vars is not None
@@ -135,7 +160,8 @@ class KerasState(_elastic.LiveObjectState):
             # hard-fail-on-drift contract exists to prevent.
             raise ValueError(
                 "commit contains optimizer slot state but the model has "
-                "no optimizer — compile() the model before restore()"
+                "no usable optimizer — compile() (and build) the model "
+                "before restore()"
             )
         if opt is not None and opt_vars is not None:
             if len(opt_vars) != len(opt.variables):
@@ -184,18 +210,17 @@ class KerasState(_elastic.LiveObjectState):
     def sync(self) -> None:
         """Fan the root's current state out to every rank."""
         import horovod_tpu as hvd
+        from horovod_tpu.keras import _model_variables
 
         hvdk = _hvdk()
-        variables = []
-        if self.model is not None:
-            variables += list(self.model.variables)
         # Build before broadcasting: a built-ness mismatch across ranks
         # (root restored, others fresh) would diverge the per-index
-        # variable list and mismatch the gang's collectives.
-        opt = self._ensure_built_optimizer()
-        if opt is not None:
-            known = {id(v) for v in variables}
-            variables += [v for v in opt.variables if id(v) not in known]
+        # variable list and mismatch the gang's collectives.  Variable
+        # collection itself is shared with the broadcast callback
+        # (_model_variables) so the two lists cannot drift.
+        self._ensure_built_optimizer()
+        variables = (_model_variables(self.model)
+                     if self.model is not None else [])
         hvdk.broadcast_variables(variables, 0)
         agreed = hvd.broadcast_object(
             {"scalars": dict(object.__getattribute__(self, "_scalars")),

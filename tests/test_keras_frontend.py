@@ -394,3 +394,47 @@ def test_keras_state_rejects_restore_before_compile(tmp_path):
     fresh = hvdk.elastic.KerasState(bare, ckpt_dir=str(tmp_path), epoch=0)
     with pytest.raises(RuntimeError, match="compile"):
         fresh.restore()
+
+
+def test_keras_state_deferred_build_model(tmp_path):
+    """A deferred-build model (no Input layer): restore() on a fresh
+    start must NOT build the optimizer over zero variables (that would
+    pin it to 0 slots and crash the first fit), and restoring a
+    weights-carrying commit into the unbuilt model raises clearly."""
+    keras.utils.set_random_seed(0)
+    deferred = keras.Sequential([keras.layers.Dense(4),
+                                 keras.layers.Dense(2)])
+    deferred.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    assert not deferred.built
+    state = hvdk.elastic.KerasState(deferred, epoch=0)
+    state.restore()                     # fresh start: plain sync, no poison
+    assert not deferred.optimizer.built
+    x, y = _data()
+    deferred.fit(x, y, batch_size=16, epochs=1, verbose=0)  # builds fine
+
+    state.commit()
+    keras.utils.set_random_seed(1)
+    deferred2 = keras.Sequential([keras.layers.Dense(4),
+                                  keras.layers.Dense(2)])
+    deferred2.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)), loss="mse")
+    s2 = hvdk.elastic.KerasState(deferred2, epoch=0)
+    object.__setattr__(s2, "_mem_commit",
+                       object.__getattribute__(state, "_mem_commit"))
+    with pytest.raises(ValueError, match="unbuilt"):
+        s2.restore()
+
+
+def test_keras_state_model_none_rejects_payload_commit(tmp_path):
+    """A scalar-only KerasState restoring a commit that carries model
+    state must hard-fail, not silently resume from random weights."""
+    model = _model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1)), loss="mse")
+    _fit_briefly(model)
+    hvdk.elastic.KerasState(model, ckpt_dir=str(tmp_path), epoch=1).commit()
+
+    bare = hvdk.elastic.KerasState(ckpt_dir=str(tmp_path), epoch=0)
+    with pytest.raises(RuntimeError, match="no\\s+model"):
+        bare.restore()
