@@ -150,6 +150,26 @@ def main() -> None:
         assert (err < 2e-3).all(), (me, delta.ravel()[:3])   # still ~mean
         assert (err > 1e-5).all(), (me, delta.ravel()[:3])   # fp16 rounded
 
+    # --- 5b. composes with keras-3 native gradient accumulation -------
+    # (the reference's backward_passes_per_step capability: the wrapper
+    # reduces every microbatch — correct, if not bandwidth-minimal — and
+    # keras's own accumulator applies every N steps.)
+    keras.utils.set_random_seed(321)   # identical on both ranks
+    model_ga = keras.Sequential([keras.layers.Input((3,)),
+                                 keras.layers.Dense(2)])
+    opt_ga = hvd.DistributedOptimizer(keras.optimizers.SGD(
+        learning_rate=0.5, gradient_accumulation_steps=2))
+    model_ga.compile(optimizer=opt_ga, loss="mse")
+    xga = np.asarray(rng.randn(16, 3), np.float32)   # rank-different data
+    yga = np.asarray(rng.randn(16, 2), np.float32)
+    model_ga.fit(xga, yga, batch_size=4, epochs=1, shuffle=False,
+                 verbose=0)
+    w_ga = np.concatenate([v.numpy().ravel()
+                           for v in model_ga.trainable_variables])
+    g_ga = hvd.allgather(w_ga[None, :], name="ga.weights")
+    assert np.array_equal(g_ga[0], g_ga[1]), (
+        me, np.abs(g_ga[0] - g_ga[1]).max())
+
     # --- 6. KerasState sync: divergent state adopts rank 0's ----------
     keras.utils.set_random_seed(500 + me)   # diverge weights again
     model4 = keras.Sequential([keras.layers.Input((3,)),
