@@ -472,3 +472,26 @@ def test_eager_overhead_bench_single_arm():
     assert rec["arm"] == "single.fused"
     assert rec["ops_per_sec"] > 0
     assert rec["tensors_fused"] == 8  # 2 rounds x 4-tensor fused bursts
+
+
+def test_sustained_run_smoke():
+    """tools/tpu_sustained_run.py --smoke: the stability harness's CPU CI
+    shape (producer of the sustained-run artifacts), SUMMARY parseable
+    with the drift/stall fields present."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "tools", "tpu_sustained_run.py"), "--smoke"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = [l for l in out.stdout.splitlines() if l.startswith("SUMMARY ")]
+    assert len(line) == 1, out.stdout
+    rec = json.loads(line[0].split("SUMMARY ", 1)[1])
+    assert rec["smoke"] is True
+    assert rec["total_steps"] > 0
+    assert "drift_pct" in rec and "stalled_groups" in rec
