@@ -255,6 +255,18 @@ def test_preserved_window_artifact_surfacing(bench, tmp_path, monkeypatch):
     assert got is not None and got["value"] == 2000.0   # full bench wins
     assert got["artifact_path"].endswith("BENCH_window_111.json")
 
+    # Equal mtimes (a fresh git checkout stamps every artifact alike):
+    # the artifact covering more bench arms wins the tiebreak.
+    full = art_dir / "BENCH_window_full_222.json"
+    full.write_text(_json.dumps(
+        {"metric": "m", "value": 1500.0,
+         "extras": {"backend": "tpu", "resnet50": 1, "vit": 2}}))
+    stamp = 1_700_000_000
+    for p in art_dir.glob("BENCH_window_*.json"):
+        os.utime(p, (stamp, stamp))
+    got = bench._preserved_window_artifact()
+    assert got["artifact_path"].endswith("BENCH_window_full_222.json")
+
 
 def test_stage_stall_watchdog_fires_in_subprocess(tmp_path):
     """The r4 wedged-tunnel fix: a worker whose stage stops advancing must

@@ -61,6 +61,17 @@ def main() -> None:
     faulthandler.dump_traceback_later(int(budget_s + 600), exit=True)
 
     import jax
+
+    if args.smoke or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # The pool plugin's sitecustomize forces jax_platforms=axon,cpu
+        # at interpreter start, overriding the env var — the CPU smoke
+        # would then dial the tunnel (and hang through a claim timeout)
+        # before falling back.  An explicit config update wins (same
+        # trick as tests/conftest.py and tools/tpu_perf_sweep.py).
+        # --smoke is CPU-shaped by definition, so it pins even when the
+        # caller forgot the env var.
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import optax
 
