@@ -435,15 +435,21 @@ def decode_step(
         k = apply_rope(k, cos, sin)
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        # GQA: [B, M, KVH, Dh] → [B, M, H, Dh] via repeat on the head axis
-        kr = jnp.repeat(kc, n_rep, axis=2) if n_rep > 1 else kc
-        vr = jnp.repeat(vc, n_rep, axis=2) if n_rep > 1 else vc
+        # GQA via grouped einsum: fold the query heads onto their KV head
+        # ([B, 1, H, Dh] → [B, 1, KVH, R, Dh], q head h ↔ kv head h//R —
+        # the same mapping _repeat_kv uses) instead of materializing the
+        # repeat-expanded cache.  The expansion would read/write R× the
+        # cache per step — decode's whole cost is cache traffic — while
+        # the grouped form reads it once and hands the MXU R query rows
+        # per KV-head matmul instead of one.
+        qg = q.reshape(b, 1, cfg.n_kv_heads, n_rep, cfg.head_dim)
         s = jnp.einsum(
-            "bqhd,bmhd->bhqm", q.astype(jnp.float32), kr.astype(jnp.float32)
-        ) * scale                                         # [B, H, 1, M]
-        s = jnp.where(valid[None, None, None, :], s, -1e30)
+            "bqkrd,bmkd->bkrqm", qg.astype(jnp.float32),
+            kc.astype(jnp.float32)
+        ) * scale                                         # [B, KVH, R, 1, M]
+        s = jnp.where(valid[None, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqm,bmhd->bqhd", p, vr.astype(jnp.float32))
+        o = jnp.einsum("bkrqm,bmkd->bqkrd", p, vc.astype(jnp.float32))
         x = x + o.astype(dt).reshape(b, 1, cfg.dim) @ lp["wo"].astype(dt)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
