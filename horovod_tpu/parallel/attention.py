@@ -22,6 +22,7 @@ All functions take ``[B, L, H, Dh]`` Q and ``[B, L, KVH, Dh]`` K/V (GQA when
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple
 
@@ -101,16 +102,23 @@ def _block_update(
     """
     b, lq, h, d = q.shape
     kvh = k.shape[2]
-    k = _repeat_kv(k, h // kvh)
-    v = _repeat_kv(v, h // kvh)
+    r = h // kvh
+    lk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    # GQA via grouped einsum (query head g = kv·r + j ↔ kv head g // r,
+    # the _repeat_kv mapping): fold the r query heads onto their KV head
+    # instead of materializing the repeat-expanded K/V — in the ring this
+    # block runs per rotation step, so the expansion would cost r× the KV
+    # traffic every step.  The merged (kvh, r) axes are adjacent and in
+    # head order, so the reshape back to [B, H, ...] is a free view.
+    qg = q.reshape(b, lq, kvh, r, d)
+    s = jnp.einsum("bqkjd,bmkd->bkjqm", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)).reshape(b, h, lq, lk) * scale
     if causal:
         qpos = (q_positions if q_positions is not None
                 else q_offset + jnp.arange(lq))[:, None]
         kpos = (kv_positions if kv_positions is not None
-                else kv_offset + jnp.arange(k.shape[1]))[None, :]
+                else kv_offset + jnp.arange(lk))[None, :]
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     if kv_valid is not None:
         s = jnp.where(kv_valid[None, None, None, :], s, NEG_INF)
@@ -121,7 +129,9 @@ def _block_update(
     l_new = state.l * correction + p.sum(axis=-1)
     o_new = (
         state.o * jnp.transpose(correction, (0, 2, 1))[..., None]
-        + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        + jnp.einsum("bkjqm,bmkd->bqkjd",
+                     p.reshape(b, kvh, r, lq, lk),
+                     v.astype(jnp.float32)).reshape(b, lq, h, d)
     )
     return _SoftmaxState(o_new, m_new, l_new)
 
@@ -264,6 +274,14 @@ def ring_attention(
     # final rotation's result would be discarded, and XLA cannot DCE a
     # collective inside the scan body (one full KV exchange saved per call).
     state = _init_state(q)
+    # The zero-init state is unvarying over the mesh axis while the
+    # updated state varies with this rank's q — under shard_map's
+    # varying-axes check (check_vma, on by default) the scan carry types
+    # would then mismatch.  Mark the init as varying so callers don't
+    # need check_vma=False.
+    _pvary = (functools.partial(lax.pcast, to="varying")
+              if hasattr(lax, "pcast") else lax.pvary)  # jax < 0.8
+    state = jax.tree.map(lambda x: _pvary(x, axis_name), state)
     if n > 1:
         (state, k, v), _ = lax.scan(step, (state, k, v), jnp.arange(n - 1))
     state = _block_update(

@@ -62,7 +62,6 @@ def test_ring_attention_matches_dense(causal):
             mesh=hvd.mesh(),
             in_specs=P(None, "hvd"),
             out_specs=P(None, "hvd"),
-            check_vma=False,
         )
     )
     out = f(q, k, v)
@@ -85,7 +84,6 @@ def test_ring_attention_gradients_flow():
             mesh=hvd.mesh(),
             in_specs=P(None, "hvd"),
             out_specs=P(None, "hvd"),
-            check_vma=False,
         )
     )
     gq, gk, gv = f(q, k, v)
@@ -109,7 +107,6 @@ def test_ulysses_matches_dense(causal):
             mesh=hvd.mesh(),
             in_specs=P(None, "hvd"),
             out_specs=P(None, "hvd"),
-            check_vma=False,
         )
     )
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-5)
@@ -124,7 +121,6 @@ def test_ulysses_rejects_indivisible_heads():
                 mesh=hvd.mesh(),
                 in_specs=P(None, "hvd"),
                 out_specs=P(None, "hvd"),
-                check_vma=False,
             )
         )(q, k, v)
 
@@ -272,7 +268,6 @@ def test_zigzag_ring_matches_dense(causal):
             mesh=hvd.mesh(),
             in_specs=P(None, "hvd"),
             out_specs=P(None, "hvd"),
-            check_vma=False,
         )
     )
     out = zigzag_unshard(f(qz, kz, vz), n)
