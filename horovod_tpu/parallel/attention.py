@@ -300,16 +300,28 @@ def ulysses_attention(
     Inside ``shard_map`` with sequence sharded: all-to-all re-shards from
     [B, L/n, H, D] (seq-sharded) to [B, L, H/n, D] (head-sharded), runs full
     attention on the n-th of the heads, and all-to-alls back.  Requires
-    ``H % n == 0`` (and ``KVH % n == 0``); one balanced a2a each way rides
-    ICI's full bisection bandwidth.
+    ``H % n == 0``; one balanced a2a each way rides ICI's full bisection
+    bandwidth.
+
+    GQA with fewer KV heads than the axis (``KVH < n``): KV heads are
+    expanded to ``n`` before their a2a (``n % KVH == 0`` required), so each
+    device carries one (replicated-group) KV head.  The mapping stays
+    consistent: device i's query heads [i·H/n, (i+1)·H/n) all belong to
+    original KV head ``i // (n/KVH)``, which is exactly what expanded head
+    i holds.  Costs (n/KVH)× the KV a2a bytes — still far below the q/o
+    legs when H ≫ KVH, and it is what makes 8-way Ulysses possible on
+    4-KV-head models at all.
     """
     n = lax.axis_size(axis_name)
     h, kvh = q.shape[2], k.shape[2]
-    if h % n or kvh % n:
+    if h % n or (kvh % n if kvh >= n else n % kvh):
         raise ValueError(
-            f"ulysses_attention needs heads divisible by axis size: "
-            f"H={h}, KVH={kvh}, n={n}"
+            f"ulysses_attention needs H divisible by the axis size and "
+            f"KVH % n == 0 or n % KVH == 0: H={h}, KVH={kvh}, n={n}"
         )
+    if kvh < n:
+        k = _repeat_kv(k, n // kvh)
+        v = _repeat_kv(v, n // kvh)
     # seq-sharded → head-sharded
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)

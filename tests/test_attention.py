@@ -112,17 +112,51 @@ def test_ulysses_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("local_impl", ["dense", "flash"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_gqa_fewer_kv_heads_than_axis(causal, local_impl):
+    """KVH < n: KV heads expand to the axis size before their a2a, so an
+    8-way Ulysses runs on a 2-KV-head model (each device carries one
+    replicated-group KV head aligned with its query-head block) — with
+    both local engines (flash sees kvh_local=1 after the expansion)."""
+    if local_impl == "flash":
+        from horovod_tpu.parallel.flash_attention import flash_attention
+
+        impl = flash_attention
+        l = 256      # flash wants block-sized sequences after the a2a
+    else:
+        impl, l = None, 64
+    q, k, v = _qkv(b=2, l=l, h=8, kvh=2, d=16)
+    ref = dense_attention(q, k, v, causal=causal)
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, axis_name="hvd", causal=causal, impl=impl),
+            mesh=hvd.mesh(),
+            in_specs=P(None, "hvd"),
+            out_specs=P(None, "hvd"),
+            # pallas out-shapes carry no vma under shard_map (same reason
+            # the llama ulysses_flash path runs with the check off)
+            check_vma=(local_impl == "dense"),
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)), np.asarray(ref), atol=3e-5)
+
+
 def test_ulysses_rejects_indivisible_heads():
-    q, k, v = _qkv(b=1, l=16, h=4, kvh=4, d=8)
-    with pytest.raises(ValueError, match="divisible"):
-        jax.jit(
-            jax.shard_map(
-                lambda q, k, v: ulysses_attention(q, k, v, axis_name="hvd"),
-                mesh=hvd.mesh(),
-                in_specs=P(None, "hvd"),
-                out_specs=P(None, "hvd"),
-            )
-        )(q, k, v)
+    for h, kvh in ((4, 4),    # H % n != 0
+                   (8, 3)):   # KVH < n with n % KVH != 0
+        q, k, v = _qkv(b=1, l=16, h=h, kvh=kvh, d=8)
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(
+                jax.shard_map(
+                    lambda q, k, v: ulysses_attention(q, k, v, axis_name="hvd"),
+                    mesh=hvd.mesh(),
+                    in_specs=P(None, "hvd"),
+                    out_specs=P(None, "hvd"),
+                )
+            )(q, k, v)
 
 
 @pytest.mark.parametrize("causal", [True, False])
