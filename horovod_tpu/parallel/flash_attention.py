@@ -35,6 +35,30 @@ from horovod_tpu.parallel.attention import blockwise_attention
 NEG_INF = -1e30
 
 
+def _out_vma(*arrays):
+    """Varying-mesh-axes set for kernel outputs: the union of the inputs'.
+
+    Under ``shard_map``'s default varying-axes check a ``pallas_call``
+    out_shape with no ``vma`` is an error — declaring "varies like the
+    inputs" lets the flash kernels run without ``check_vma=False``.
+    Outside shard_map every input vma is empty → ``None`` (a plain aval).
+    """
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:             # older jax: no vma system at all
+        return None
+    vma: frozenset = frozenset()
+    for a in arrays:
+        vma = vma | getattr(typeof(a), "vma", frozenset())
+    return vma or None
+
+
+def _sds(shape, dtype, vma):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:          # older jax: no vma parameter
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
                   seq_len: int):
@@ -122,6 +146,7 @@ def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
         head = b % n_heads
         return (batch * n_kv_heads + head // n_rep, j, 0)
 
+    vma = _out_vma(q, k, v)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -138,8 +163,8 @@ def _flash_forward(q, k, v, *, n_heads: int, n_kv_heads: int, causal: bool,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq_pad, 1), jnp.float32),
+            _sds((bh, lq_pad, d), q.dtype, vma),
+            _sds((bh, lq_pad, 1), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
@@ -275,6 +300,7 @@ def _flash_backward(q, k, v, o, lse, g, *, n_heads, n_kv_heads, causal,
         v = jnp.pad(v, ((0, 0), (0, lk_pad - l), (0, 0)))
     delta = delta[..., None]                                         # [BH, Lq, 1]
     scale = 1.0 / math.sqrt(d)
+    vma = _out_vma(q, k, v, g)
 
     def kv_index(b, i, j):
         batch = b // n_heads
@@ -296,7 +322,7 @@ def _flash_backward(q, k, v, o, lse, g, *, n_heads, n_kv_heads, causal,
         grid=(bh, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), q.dtype),
+        out_shape=_sds((bh, lq_pad, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
@@ -323,8 +349,8 @@ def _flash_backward(q, k, v, o, lse, g, *, n_heads, n_kv_heads, causal,
         in_specs=[qk_spec, kvk_spec, kvk_spec, qk_spec, rk_spec, rk_spec],
         out_specs=[dkv_out_spec, dkv_out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lk_pad, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lk_pad, d), v.dtype),
+            _sds((bh, lk_pad, d), k.dtype, vma),
+            _sds((bh, lk_pad, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
