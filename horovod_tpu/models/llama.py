@@ -30,6 +30,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -346,7 +347,10 @@ def num_params(cfg: LlamaConfig) -> int:
 
 class KVCache(NamedTuple):
     """Per-layer key/value buffers: k/v [n_layers, B, max_len, KVH, Dh];
-    ``length`` is the number of filled positions (scalar int32)."""
+    ``length`` is the number of filled positions — a scalar int32 when all
+    rows are in lockstep (the fast path: one dynamic_update_slice per
+    step), or [B] int32 for ragged rows (continuous-batching shape: each
+    row's next write lands at its own position via scatter)."""
 
     k: jax.Array
     v: jax.Array
@@ -364,6 +368,7 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int) -> KVCache:
 
 def prefill(
     params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
+    lengths: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling cache[:, :, :L].
 
@@ -371,8 +376,24 @@ def prefill(
     stacked-layer scan as :func:`forward`; attention is the configured
     engine (the flash kernel applies here — prefill is the MXU-bound
     phase).
+
+    ``lengths`` [B]: optional per-row prompt lengths for RIGHT-padded
+    ragged batches (continuous-batching shape), each in [1, L].
+    Causality already keeps valid queries from seeing the padded tail,
+    the returned logits come from each row's last valid position, and
+    the cache becomes per-row-length (pad slots carry garbage K/V that
+    the decode mask never reads and later writes overwrite).
     """
     b, l = tokens.shape
+    if lengths is not None and not isinstance(lengths, jax.core.Tracer):
+        ln = np.asarray(lengths)
+        if ln.shape != (b,) or ln.min() < 1 or ln.max() > l:
+            raise ValueError(
+                f"prefill lengths must be [batch]={b} values in [1, "
+                f"prompt width {l}], got shape {ln.shape} range "
+                f"[{ln.min() if ln.size else '-'}, "
+                f"{ln.max() if ln.size else '-'}]"
+            )
     dt = cfg.dtype
     x = params["embed"][tokens].astype(dt)
     positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
@@ -395,11 +416,17 @@ def prefill(
 
     x, (ks, vs) = lax.scan(layer, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    if lengths is None:
+        last = x[:, -1]
+        new_len = jnp.asarray(l, jnp.int32)
+    else:
+        last = x[jnp.arange(b), jnp.asarray(lengths, jnp.int32) - 1]
+        new_len = jnp.asarray(lengths, jnp.int32)     # [B] — ragged cache
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
     cache = KVCache(
         k=lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0)),
         v=lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0)),
-        length=jnp.asarray(l, jnp.int32),
+        length=new_len,
     )
     return logits, cache
 
@@ -413,17 +440,29 @@ def decode_step(
     position's K/V are written at index ``length``.  Decode is
     matvec-bound, so attention is a plain masked einsum in f32 — no kernel
     needed.
+
+    A scalar ``cache.length`` is the lockstep fast path (one
+    dynamic_update_slice per step); a [B] ``cache.length`` (ragged
+    prefill / continuous batching) writes each row at its own position
+    and masks per row.
     """
     b = token.shape[0]
     dt = cfg.dtype
     max_len = cache.k.shape[2]
-    pos = cache.length                                    # scalar int32
+    pos = cache.length                       # scalar or [B] int32
+    ragged = jnp.ndim(pos) > 0               # static at trace time
     x = params["embed"][token][:, None, :].astype(dt)     # [B, 1, D]
-    cos, sin = rope_tables(cfg, jnp.broadcast_to(pos, (b, 1)))
+    rope_pos = pos[:, None] if ragged else jnp.broadcast_to(pos, (b, 1))
+    cos, sin = rope_tables(cfg, rope_pos)
     n_rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / (cfg.head_dim ** 0.5)
-    # mask over cache positions: attend to [0, pos] inclusive
-    valid = jnp.arange(max_len) <= pos                    # [max_len]
+    # mask over cache positions: attend to [0, pos] inclusive (per row
+    # when ragged) — broadcasts over the [B, KVH, R, 1, M] score layout
+    if ragged:
+        valid = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B, M]
+        valid = valid[:, None, None, None, :]
+    else:
+        valid = (jnp.arange(max_len) <= pos)[None, None, None, None, :]
 
     def layer(x, inputs):
         lp, kc, vc = inputs                               # kc/vc [B, M, KVH, Dh]
@@ -433,8 +472,13 @@ def decode_step(
         v = (h @ lp["wv"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        if ragged:
+            rows = jnp.arange(b)
+            kc = kc.at[rows, pos].set(k[:, 0])
+            vc = vc.at[rows, pos].set(v[:, 0])
+        else:
+            kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+            vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         # GQA via grouped einsum: fold the query heads onto their KV head
         # ([B, 1, H, Dh] → [B, 1, KVH, R, Dh], q head h ↔ kv head h//R —
         # the same mapping _repeat_kv uses) instead of materializing the
@@ -447,7 +491,7 @@ def decode_step(
             "bqkrd,bmkd->bkrqm", qg.astype(jnp.float32),
             kc.astype(jnp.float32)
         ) * scale                                         # [B, KVH, R, 1, M]
-        s = jnp.where(valid[None, None, None, None, :], s, -1e30)
+        s = jnp.where(valid, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bkrqm,bmkd->bqkrd", p, vc.astype(jnp.float32))
         x = x + o.astype(dt).reshape(b, 1, cfg.dim) @ lp["wo"].astype(dt)
@@ -523,12 +567,18 @@ def generate(
     top_k: int | None = None,
     top_p: float | None = None,
     key: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Greedy (or sampled) generation: prompt [B, L] → [B, max_new_tokens].
 
     One prefill + one ``lax.scan`` of cached decode steps; jit-friendly
     end to end (static shapes, no per-token retracing).  Sampling knobs:
     ``temperature`` (0 = greedy), ``top_k``, ``top_p`` (nucleus).
+
+    ``prompt_lengths`` [B]: per-row lengths of a RIGHT-padded ragged
+    prompt batch — each row continues from its own last valid token
+    (mixed-length serving without per-length bucketing; the cache runs
+    ragged from the prefill on).
     """
     b, l = prompt.shape
     max_len = max_len or (l + max_new_tokens)
@@ -537,7 +587,8 @@ def generate(
             f"max_len={max_len} < prompt {l} + max_new_tokens {max_new_tokens}"
         )
     cache = init_cache(cfg, b, max_len)
-    logits, cache = prefill(params, prompt, cfg, cache)
+    logits, cache = prefill(params, prompt, cfg, cache,
+                            lengths=prompt_lengths)
     if key is None:
         key = jax.random.key(0)
 

@@ -224,6 +224,33 @@ def test_llama_prefill_logits_match_forward():
     assert int(cache.length) == 4
 
 
+def test_llama_ragged_generate_matches_per_row():
+    """Ragged right-padded prompts with prompt_lengths= — each row's
+    continuation equals generating that row alone, unpadded (the
+    continuous-batching primitive: per-row cache positions)."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    rows = [[5, 17, 42, 9, 3], [7, 7, 9, 0, 0]]      # lengths 5 and 3
+    lengths = jnp.array([5, 3], jnp.int32)
+    prompt = jnp.array(rows, jnp.int32)
+    n_new = 4
+
+    out = jax.jit(lambda p, t, ln: llama.generate(
+        p, t, cfg, max_new_tokens=n_new, max_len=16, prompt_lengths=ln,
+    ))(params, prompt, lengths)
+    assert out.shape == (2, n_new)
+
+    for r, ln in enumerate([5, 3]):
+        solo = llama.generate(
+            params, jnp.array([rows[r][:ln]], jnp.int32), cfg,
+            max_new_tokens=n_new, max_len=16,
+        )
+        np.testing.assert_array_equal(np.asarray(out[r]),
+                                      np.asarray(solo[0]))
+
+
 def test_llama_tp_partition_specs_compile():
     """GSPMD tensor parallelism: jit with megatron specs over a (dp, tp)
     mesh compiles and matches the unsharded forward."""
