@@ -1,0 +1,199 @@
+"""Continuous-batching serving loop for the llama inference stack.
+
+The reference has no serving story (its zoo is ResNet/MNIST-era,
+SURVEY.md §2.3); this is capability extension on the TPU-first side,
+built from the ragged KV-cache primitives in :mod:`horovod_tpu.models.llama`:
+
+* a fixed pool of **slots** (the compiled batch dimension — shapes never
+  change, so the decode step is one cached XLA program for the life of
+  the server);
+* **admission** of a new request into a free slot mid-stream: a B=1
+  ragged ``prefill`` (padded to one static width so every admission hits
+  the same compiled program) whose K/V window is spliced into the pool
+  cache at the slot row;
+* a **decode tick** advancing every slot one token (per-row cache
+  positions and masks do the isolation — a freshly admitted short prompt
+  and a slot 900 tokens into its answer share the same batched matvecs);
+* host-side orchestration only at the boundaries (which slot is free,
+  which request is done) — the standard serving-engine split: control
+  flow on the host, one compiled program per phase on the device.
+
+Isolation is exact: rows are independent in attention, so each request's
+greedy continuation is bit-identical to running it alone (pinned by
+``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.models import llama
+from horovod_tpu.models.llama import KVCache
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: prompt token ids + a new-token budget."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    eos_id: int | None = None
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _splice(cache: KVCache, k_new: jax.Array, v_new: jax.Array,
+            slot: jax.Array, length: jax.Array) -> KVCache:
+    """Write a B=1 prefill's K/V window into slot ``slot`` of the pool.
+
+    k_new/v_new: [n_layers, 1, W, KVH, Dh] — the admission window (W is
+    the static admission width, so this is one compiled program for all
+    admissions).  ``length`` is the row's true prompt length.
+    """
+    k = lax.dynamic_update_slice(cache.k, k_new, (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(cache.v, v_new, (0, slot, 0, 0, 0))
+    return KVCache(k=k, v=v, length=cache.length.at[slot].set(length))
+
+
+class ContinuousBatcher:
+    """Serve mixed-length requests through a fixed slot pool.
+
+    ``n_slots`` is the compiled batch size; ``max_len`` bounds prompt +
+    generation per request; ``admit_width`` is the static prompt-padding
+    width every admission compiles against (prompts longer than it are
+    rejected).  ``greedy`` only — sampling would need per-slot PRNG
+    streams to keep the solo-equivalence property.
+    """
+
+    def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
+                 n_slots: int, max_len: int, admit_width: int):
+        if admit_width > max_len:
+            raise ValueError(
+                f"admit_width {admit_width} > max_len {max_len}: the "
+                f"admission window must fit inside the pool cache")
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.admit_width = admit_width
+        self.cache = llama.init_cache(cfg, n_slots, max_len)
+        # ragged from birth: every row owns its position
+        self.cache = self.cache._replace(
+            length=jnp.zeros((n_slots,), jnp.int32))
+        self.last_logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
+        # host-side slot state
+        self._busy = [False] * n_slots
+        self._budget = [0] * n_slots
+        self._eos = [None] * n_slots
+        self._out: list[list[int]] = [[] for _ in range(n_slots)]
+
+        @jax.jit
+        def _prefill_one(params, tokens, length):
+            cache = llama.init_cache(cfg, 1, admit_width)
+            cache = cache._replace(length=jnp.zeros((1,), jnp.int32))
+            logits, cache = llama.prefill(params, tokens, cfg, cache,
+                                          lengths=length)
+            return logits[0], cache.k, cache.v
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def _tick(params, cache, last_logits):
+            # donation matters here: without it every tick copies the
+            # whole pool K/V (decode's cost IS cache traffic)
+            tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            logits, cache = llama.decode_step(params, tok, cfg, cache)
+            return tok, logits, cache
+
+        self._prefill_one = _prefill_one
+        self._tick = _tick
+
+    # -- admission ---------------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        return [i for i, b in enumerate(self._busy) if not b]
+
+    def admit(self, req: Request) -> int:
+        """Prefill ``req`` into a free slot; returns the slot index."""
+        L = len(req.prompt)
+        if not 1 <= L <= self.admit_width:
+            raise ValueError(
+                f"prompt length {L} outside [1, admit_width="
+                f"{self.admit_width}]")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if L + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {L} + max_new_tokens {req.max_new_tokens} "
+                f"exceeds max_len {self.max_len}")
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot; call step() until one opens")
+        slot = free[0]
+        padded = np.zeros((1, self.admit_width), np.int32)
+        padded[0, :L] = req.prompt
+        logits, k_new, v_new = self._prefill_one(
+            self.params, jnp.asarray(padded), jnp.asarray([L], jnp.int32))
+        self.cache = _splice(self.cache, k_new, v_new,
+                             jnp.asarray(slot, jnp.int32),
+                             jnp.asarray(L, jnp.int32))
+        self.last_logits = self.last_logits.at[slot].set(logits)
+        self._busy[slot] = True
+        self._budget[slot] = req.max_new_tokens
+        self._eos[slot] = req.eos_id
+        self._out[slot] = []
+        return slot
+
+    # -- decode ------------------------------------------------------------
+
+    def step(self) -> dict[int, list[int]]:
+        """Advance every slot one token; returns {slot: tokens} for
+        requests that finished on this tick."""
+        tok, self.last_logits, self.cache = self._tick(
+            self.params, self.cache, self.last_logits)
+        done: dict[int, list[int]] = {}
+        tok_host = np.asarray(tok)
+        for slot in range(self.n_slots):
+            if not self._busy[slot]:
+                continue
+            t = int(tok_host[slot])
+            self._out[slot].append(t)
+            self._budget[slot] -= 1
+            if self._budget[slot] <= 0 or t == self._eos[slot]:
+                done[slot] = self._out[slot]
+                self._busy[slot] = False
+                # Rewind the row to 0.  Free rows still tick with the
+                # batch (one compiled program for all slots), so the
+                # position resumes advancing and scatters garbage K/V
+                # from 0 upward — which is safe because every occupant
+                # WRITES positions before attending to them: admission
+                # splices [0, L) and each decode step writes pos before
+                # reading [0, pos].  The rewind's only job is keeping
+                # the write position in bounds on long-idle slots.
+                # (Anything that reads cache rows it didn't write —
+                # e.g. a future speculative-decode path — must re-splice
+                # or re-validate the row first.)
+                self.cache = self.cache._replace(
+                    length=self.cache.length.at[slot].set(0))
+        return done
+
+    # -- convenience -------------------------------------------------------
+
+    def run(self, requests: list[Request]) -> list[list[int]]:
+        """Serve ``requests`` to completion (admission order, slots
+        recycled as they free up); returns each request's tokens."""
+        results: list[list[int] | None] = [None] * len(requests)
+        slot_owner: dict[int, int] = {}
+        pending = list(enumerate(requests))
+        while pending or slot_owner:
+            while pending and self.free_slots():
+                idx, req = pending.pop(0)
+                slot_owner[self.admit(req)] = idx
+            for slot, toks in self.step().items():
+                results[slot_owner.pop(slot)] = toks
+        assert all(r is not None for r in results)
+        return results  # type: ignore[return-value]
