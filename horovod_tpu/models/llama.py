@@ -443,26 +443,23 @@ def decode_step(
 
     A scalar ``cache.length`` is the lockstep fast path (one
     dynamic_update_slice per step); a [B] ``cache.length`` (ragged
-    prefill / continuous batching) writes each row at its own position
-    and masks per row.
+    prefill / continuous batching) delegates to :func:`decode_chunk`
+    with T=1 — identical math, per-row scatter writes and masks.
     """
+    if jnp.ndim(cache.length) > 0:           # ragged: one code path (T=1)
+        logits, cache = decode_chunk(params, token[:, None], cfg, cache)
+        return logits[:, 0], cache
     b = token.shape[0]
     dt = cfg.dtype
     max_len = cache.k.shape[2]
-    pos = cache.length                       # scalar or [B] int32
-    ragged = jnp.ndim(pos) > 0               # static at trace time
+    pos = cache.length                       # scalar int32
     x = params["embed"][token][:, None, :].astype(dt)     # [B, 1, D]
-    rope_pos = pos[:, None] if ragged else jnp.broadcast_to(pos, (b, 1))
-    cos, sin = rope_tables(cfg, rope_pos)
+    cos, sin = rope_tables(cfg, jnp.broadcast_to(pos, (b, 1)))
     n_rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / (cfg.head_dim ** 0.5)
-    # mask over cache positions: attend to [0, pos] inclusive (per row
-    # when ragged) — broadcasts over the [B, KVH, R, 1, M] score layout
-    if ragged:
-        valid = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B, M]
-        valid = valid[:, None, None, None, :]
-    else:
-        valid = (jnp.arange(max_len) <= pos)[None, None, None, None, :]
+    # mask over cache positions: attend to [0, pos] inclusive —
+    # broadcasts over the [B, KVH, R, 1, M] score layout
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, None, :]
 
     def layer(x, inputs):
         lp, kc, vc = inputs                               # kc/vc [B, M, KVH, Dh]
@@ -472,13 +469,8 @@ def decode_step(
         v = (h @ lp["wv"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if ragged:
-            rows = jnp.arange(b)
-            kc = kc.at[rows, pos].set(k[:, 0])
-            vc = vc.at[rows, pos].set(v[:, 0])
-        else:
-            kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-            vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         # GQA via grouped einsum: fold the query heads onto their KV head
         # ([B, 1, H, Dh] → [B, 1, KVH, R, Dh], q head h ↔ kv head h//R —
         # the same mapping _repeat_kv uses) instead of materializing the
@@ -505,6 +497,66 @@ def decode_step(
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, KVCache(k=ks, v=vs, length=pos + 1)
+
+
+def decode_chunk(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """Consume T tokens per row in ONE pass: ``tokens`` [B, T] →
+    (logits [B, T, V], cache advanced by T).
+
+    The T-token generalization of :func:`decode_step` (same per-row
+    position/mask machinery, scalar or [B] ``cache.length``): token j of
+    row r lands at cache position ``pos_r + j`` and attends to
+    ``[0, pos_r + j]``.  Logits at every chunk position come back — this
+    is the verification pass of speculative decoding (one MXU-friendly
+    T-row matmul instead of T matvecs) and equally the chunked-prefill
+    building block for feeding long prompts through a bounded window.
+    """
+    b, t = tokens.shape
+    dt = cfg.dtype
+    max_len = cache.k.shape[2]
+    pos = cache.length
+    posv = pos if jnp.ndim(pos) > 0 else jnp.broadcast_to(pos, (b,))
+    x = params["embed"][tokens].astype(dt)                # [B, T, D]
+    qpos = posv[:, None] + jnp.arange(t)[None, :]         # [B, T]
+    cos, sin = rope_tables(cfg, qpos)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    # key m visible to query j of row r iff m <= pos_r + j
+    valid = jnp.arange(max_len)[None, None, :] <= qpos[:, :, None]
+    valid = valid[:, None, None, :, :]                    # [B,1,1,T,M]
+    rows = jnp.arange(b)[:, None]
+
+    def layer(x, inputs):
+        lp, kc, vc = inputs
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kc = kc.at[rows, qpos].set(k)                     # [B,T,…] scatter
+        vc = vc.at[rows, qpos].set(v)
+        qg = q.reshape(b, t, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        s = jnp.einsum(
+            "bqkrd,bmkd->bkrqm", qg.astype(jnp.float32),
+            kc.astype(jnp.float32)
+        ) * scale                                         # [B,KVH,R,T,M]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkrqm,bmkd->bqkrd", p, vc.astype(jnp.float32))
+        x = x + o.astype(dt).reshape(b, t, cfg.dim) @ lp["wo"].astype(dt)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (kc, vc)
+
+    x, (ks, vs) = lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, KVCache(k=ks, v=vs, length=pos + t)
 
 
 def sample_logits(

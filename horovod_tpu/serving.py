@@ -197,3 +197,129 @@ class ContinuousBatcher:
                 results[slot_owner.pop(slot)] = toks
         assert all(r is not None for r in results)
         return results  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (draft-and-verify), greedy acceptance.
+# ---------------------------------------------------------------------------
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_programs(cfg: llama.LlamaConfig, draft_cfg: llama.LlamaConfig,
+                   draft_k: int):
+    """Compiled draft/verify programs, cached per (configs, draft_k) so
+    repeated speculative_generate calls reuse one XLA compile (the same
+    lifetime pattern as ContinuousBatcher's held closures)."""
+
+    @jax.jit
+    def draft_round(dparams, dcache, first_tok):
+        """first_tok + draft_k-1 more draft tokens (k decode steps)."""
+        def step(carry, _):
+            tok, cache = carry
+            logits, cache = llama.decode_step(dparams, tok, draft_cfg,
+                                              cache)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, cache), nxt
+
+        (_, dcache), drafts = lax.scan(
+            step, (first_tok, dcache), None, length=draft_k)
+        return jnp.moveaxis(drafts, 0, 1), dcache      # [B, draft_k]
+
+    @jax.jit
+    def verify_round(params_, tcache, chunk):
+        logits, tcache = llama.decode_chunk(params_, chunk, cfg, tcache)
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+        return logits, preds, tcache
+
+    return draft_round, verify_round
+
+
+def speculative_generate(
+    params: dict,
+    cfg: llama.LlamaConfig,
+    draft_params: dict,
+    draft_cfg: llama.LlamaConfig,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    draft_k: int = 4,
+    max_len: int | None = None,
+    prompt_lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy speculative decoding: a small draft model proposes
+    ``draft_k`` tokens per round, the target verifies them all in ONE
+    :func:`~horovod_tpu.models.llama.decode_chunk` pass, and the longest
+    matching prefix is accepted plus the target's own next token.
+
+    With greedy acceptance the output is **bit-identical to the target's
+    own greedy** ``generate`` — the draft only changes how many target
+    passes it takes (1 + accepted per round instead of 1 per token), so
+    any draft, however bad, is safe (pinned by ``tests/test_serving.py``).
+
+    Batched with PER-ROW acceptance: rows accept different prefix lengths
+    each round, which makes every cache ragged — the [B] ``length``
+    vector IS the rewind (stale K/V beyond it is masked and rewritten
+    before any read, the same write-before-read invariant the slot pool
+    relies on).  Returns [B, max_new_tokens].
+    """
+    b, l = prompt.shape
+    max_len = max_len or (l + max_new_tokens + draft_k + 1)
+    if max_len < l + max_new_tokens + draft_k + 1:
+        raise ValueError(
+            f"max_len={max_len} < prompt {l} + max_new_tokens "
+            f"{max_new_tokens} + draft_k {draft_k} + 1 (verification "
+            f"overshoot needs the slack)")
+
+    tcache = llama.init_cache(cfg, b, max_len)
+    dcache = llama.init_cache(draft_cfg, b, max_len)
+    lengths = (jnp.full((b,), l, jnp.int32) if prompt_lengths is None
+               else jnp.asarray(prompt_lengths, jnp.int32))
+    tlog, tcache = llama.prefill(params, prompt, cfg, tcache,
+                                 lengths=lengths)
+    _, dcache = llama.prefill(draft_params, prompt, draft_cfg, dcache,
+                              lengths=lengths)
+
+    draft_round, verify_round = _spec_programs(cfg, draft_cfg, draft_k)
+
+    out = np.zeros((b, max_new_tokens), np.int32)
+    emitted = np.zeros(b, np.int32)
+    rows = np.arange(b)
+
+    def emit(row, tok):
+        if emitted[row] < max_new_tokens:
+            out[row, emitted[row]] = tok
+            emitted[row] += 1
+
+    while (emitted < max_new_tokens).any():
+        cur = jnp.argmax(tlog, axis=-1).astype(jnp.int32)     # [B]
+        cur_host = np.asarray(cur)
+        for r in rows:
+            emit(r, int(cur_host[r]))
+        # draft proposes cur's continuations: d_1..d_k
+        drafts, dcache = draft_round(draft_params, dcache, cur)
+        # target consumes [cur, d_1..d_{k-1}] in one chunk; preds[:, i]
+        # is the target's greedy token after chunk[:, :i+1]
+        chunk = jnp.concatenate([cur[:, None], drafts[:, :-1]], axis=1)
+        logits, preds, tcache = verify_round(params, tcache, chunk)
+        # per-row longest accepted prefix: d_i accepted while == preds_i-1
+        d_host = np.asarray(drafts)
+        p_host = np.asarray(preds)
+        accept = np.zeros(b, np.int32)
+        for r in rows:
+            a = 0
+            while a < draft_k - 1 and d_host[r, a] == p_host[r, a]:
+                emit(r, int(d_host[r, a]))
+                a += 1
+            accept[r] = a
+        # rewind both caches to the true accepted frontier and pick the
+        # logits that follow each row's last accepted token
+        new_len = np.asarray(lengths) + 1 + accept
+        lengths = jnp.asarray(new_len, jnp.int32)
+        tcache = tcache._replace(length=lengths)
+        dcache = dcache._replace(length=lengths)
+        tlog = logits[jnp.arange(b), jnp.asarray(accept)]      # [B, V]
+
+    return jnp.asarray(out)

@@ -251,6 +251,33 @@ def test_llama_ragged_generate_matches_per_row():
                                       np.asarray(solo[0]))
 
 
+def test_llama_decode_chunk_matches_sequential():
+    """decode_chunk(T tokens) == T sequential decode_steps — logits,
+    cache contents, and lengths — on lockstep and ragged caches."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(6))
+    prompt = jnp.array([[5, 17, 42], [7, 9, 3]], jnp.int32)
+    toks = jnp.array([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+    for lengths in (None, jnp.array([3, 2], jnp.int32)):
+        c1 = llama.init_cache(cfg, 2, 16)
+        _, c1 = llama.prefill(params, prompt, cfg, c1, lengths=lengths)
+        c2 = jax.tree.map(lambda x: x, c1)
+        seq = []
+        for j in range(4):
+            lg, c1 = llama.decode_step(params, toks[:, j], cfg, c1)
+            seq.append(lg)
+        chunk, c2 = llama.decode_chunk(params, toks, cfg, c2)
+        np.testing.assert_allclose(np.asarray(chunk),
+                                   np.asarray(jnp.stack(seq, 1)),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(c1.length),
+                                      np.asarray(c2.length))
+        np.testing.assert_allclose(np.asarray(c1.k), np.asarray(c2.k),
+                                   atol=2e-5)
+
+
 def test_llama_tp_partition_specs_compile():
     """GSPMD tensor parallelism: jit with megatron specs over a (dp, tp)
     mesh compiles and matches the unsharded forward."""

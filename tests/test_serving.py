@@ -93,3 +93,55 @@ def test_serving_slot_reuse_no_leakage(world):
     got = b.run([short_req])[0]
     want = _solo(params, cfg, short_req.prompt, 5, 16)
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+# -- speculative decoding ---------------------------------------------------
+
+
+def test_speculative_equals_plain_greedy(world):
+    """Draft-and-verify output is bit-identical to the target's own
+    greedy generate — with a good draft (the target itself), a bad draft
+    (random weights), and a differently-shaped draft."""
+    from horovod_tpu.serving import speculative_generate
+
+    cfg, params = world
+    prompt = jnp.array([[5, 17, 42], [7, 9, 3]], jnp.int32)
+    n_new = 6
+    want = np.asarray(llama.generate(
+        params, prompt, cfg, max_new_tokens=n_new, max_len=24))
+
+    drafts = {
+        "self": (cfg, params),
+        "random": (cfg, llama.init_params(cfg, jax.random.PRNGKey(99))),
+        "smaller": (
+            llama.llama_tiny(dtype=jnp.float32, dim=32, n_layers=1,
+                             n_heads=2, n_kv_heads=1, ffn_dim=64),
+            None,
+        ),
+    }
+    dcfg, dparams = drafts["smaller"]
+    drafts["smaller"] = (dcfg, llama.init_params(dcfg, jax.random.PRNGKey(5)))
+
+    for name, (dcfg, dparams) in drafts.items():
+        got = np.asarray(speculative_generate(
+            params, cfg, dparams, dcfg, prompt,
+            max_new_tokens=n_new, draft_k=3))
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_speculative_ragged_prompts(world):
+    """Speculative decoding over a ragged right-padded batch matches
+    ragged generate (per-row acceptance + per-row prompt lengths)."""
+    from horovod_tpu.serving import speculative_generate
+
+    cfg, params = world
+    prompt = jnp.array([[5, 17, 42, 9], [7, 7, 0, 0]], jnp.int32)
+    lengths = jnp.array([4, 2], jnp.int32)
+    n_new = 5
+    want = np.asarray(llama.generate(
+        params, prompt, cfg, max_new_tokens=n_new, max_len=24,
+        prompt_lengths=lengths))
+    got = np.asarray(speculative_generate(
+        params, cfg, params, cfg, prompt, max_new_tokens=n_new,
+        draft_k=3, prompt_lengths=lengths))
+    np.testing.assert_array_equal(got, want)
