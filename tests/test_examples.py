@@ -172,3 +172,11 @@ def test_keras3_mnist(tmp_path):
     finally:
         keras.distribution.set_distribution(None)
     assert (tmp_path / "model.keras").exists()
+
+
+def test_llama_serving():
+    run_example(
+        "llama_serving.py",
+        ["--requests", "3", "--slots", "2", "--new-tokens", "4",
+         "--draft-k", "2"],
+    )
