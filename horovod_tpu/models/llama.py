@@ -366,6 +366,20 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int) -> KVCache:
     )
 
 
+def _validate_lengths(lengths, b: int, l: int, fn: str) -> None:
+    """Concrete-value precondition check for ragged ``lengths`` [B] in
+    [1, padded width]; traced values are the caller's contract."""
+    if lengths is None or isinstance(lengths, jax.core.Tracer):
+        return
+    ln = np.asarray(lengths)
+    if ln.shape != (b,) or ln.min() < 1 or ln.max() > l:
+        raise ValueError(
+            f"{fn} lengths must be [batch]={b} values in [1, padded "
+            f"width {l}], got shape {ln.shape} range "
+            f"[{ln.min() if ln.size else '-'}, "
+            f"{ln.max() if ln.size else '-'}]")
+
+
 def prefill(
     params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
     lengths: jax.Array | None = None,
@@ -385,15 +399,7 @@ def prefill(
     the decode mask never reads and later writes overwrite).
     """
     b, l = tokens.shape
-    if lengths is not None and not isinstance(lengths, jax.core.Tracer):
-        ln = np.asarray(lengths)
-        if ln.shape != (b,) or ln.min() < 1 or ln.max() > l:
-            raise ValueError(
-                f"prefill lengths must be [batch]={b} values in [1, "
-                f"prompt width {l}], got shape {ln.shape} range "
-                f"[{ln.min() if ln.size else '-'}, "
-                f"{ln.max() if ln.size else '-'}]"
-            )
+    _validate_lengths(lengths, b, l, "prefill")
     dt = cfg.dtype
     x = params["embed"][tokens].astype(dt)
     positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
@@ -557,6 +563,70 @@ def decode_chunk(
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, KVCache(k=ks, v=vs, length=pos + t)
+
+
+def prefill_chunked(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
+    *, window: int, lengths: jax.Array | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Prefill a long prompt through fixed-size :func:`decode_chunk`
+    windows: activation memory is O(window·L_cache) instead of O(L²) —
+    the chunked-prefill pattern serving engines use to keep long-prompt
+    admission from spiking memory (and to interleave it with decode
+    ticks).  Output == :func:`prefill` (each row's last-valid-position
+    logits + an equivalent cache: scalar length stays scalar, so the
+    decode fast path is preserved).
+
+    The padded width must satisfy ``L % window == 0``; ragged true
+    lengths go in ``lengths`` [B] exactly as in :func:`prefill` (pad
+    positions beyond a row's length are masked by later decodes and
+    overwritten by its next tokens).  One ``lax.scan`` over windows —
+    compile size is one chunk body regardless of prompt length.
+    """
+    b, l = tokens.shape
+    if l % window:
+        raise ValueError(f"padded prompt length {l} not a multiple of "
+                         f"window {window}")
+    _validate_lengths(lengths, b, l, "prefill_chunked")
+    base = cache.length                              # scalar or [B]
+    if not isinstance(base, jax.core.Tracer):
+        # decode_chunk's scatter DROPS out-of-bounds writes, so an
+        # overflowing chunked prefill would silently return logits
+        # attending to never-written slots — fail loudly instead (the
+        # analogous one-shot prefill overflow fails at trace time).
+        if int(np.max(np.asarray(base))) + l > cache.k.shape[2]:
+            raise ValueError(
+                f"prefill_chunked would overflow the cache: base length "
+                f"{int(np.max(np.asarray(base)))} + padded width {l} > "
+                f"max_len {cache.k.shape[2]}")
+    basev = (base if jnp.ndim(base) > 0
+             else jnp.broadcast_to(base, (b,)))      # [B]
+    true_len = (jnp.asarray(lengths, jnp.int32) if lengths is not None
+                else jnp.full((b,), l, jnp.int32))
+    target = basev + true_len - 1     # absolute pos of each last token
+    windows = jnp.moveaxis(tokens.reshape(b, l // window, window), 1, 0)
+
+    def step(carry, toks_w):
+        cache, last = carry
+        start = cache.length
+        startv = (start if jnp.ndim(start) > 0
+                  else jnp.broadcast_to(start, (b,)))
+        logits, cache = decode_chunk(params, toks_w, cfg, cache)
+        # rows whose last valid token falls inside this window pick
+        # their logits; others keep what they have
+        hit = (target >= startv) & (target < startv + window)
+        idx = jnp.clip(target - startv, 0, window - 1)
+        cand = logits[jnp.arange(b), idx]
+        last = jnp.where(hit[:, None], cand, last)
+        return (cache, last), None
+
+    last0 = jnp.zeros((b, cfg.vocab_size), jnp.float32)
+    (cache, last), _ = lax.scan(step, (cache, last0), windows)
+    if lengths is not None:
+        cache = cache._replace(length=basev + true_len)
+    # else: decode_chunk preserved the scalar/[B] shape of `base`, and
+    # the scanned advance already totals base + l.
+    return last, cache
 
 
 def sample_logits(

@@ -278,6 +278,46 @@ def test_llama_decode_chunk_matches_sequential():
                                    atol=2e-5)
 
 
+def test_llama_prefill_chunked_matches_prefill():
+    """Windowed prefill == one-shot prefill (lockstep and ragged): same
+    last-valid logits, the cache decodes identically, and a lockstep
+    cache keeps its scalar length (the decode fast path)."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(8))
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 0,
+                                cfg.vocab_size)
+    for lengths in (None, jnp.array([7, 3], jnp.int32)):
+        c1 = llama.init_cache(cfg, 2, 16)
+        lg1, c1 = llama.prefill(params, tokens, cfg, c1, lengths=lengths)
+        c2 = llama.init_cache(cfg, 2, 16)
+        lg2, c2 = jax.jit(
+            lambda p, t, c: llama.prefill_chunked(
+                p, t, cfg, c, window=4, lengths=lengths)
+        )(params, tokens, c2)
+        np.testing.assert_allclose(np.asarray(lg2), np.asarray(lg1),
+                                   rtol=2e-5, atol=2e-5)
+        if lengths is None:
+            assert jnp.ndim(c2.length) == 0      # fast path preserved
+        np.testing.assert_array_equal(
+            np.broadcast_to(np.asarray(c1.length), (2,)),
+            np.broadcast_to(np.asarray(c2.length), (2,)))
+        nxt = jnp.argmax(lg1, -1).astype(jnp.int32)
+        d1, _ = llama.decode_step(params, nxt, cfg, c1)
+        d2, _ = llama.decode_step(params, nxt, cfg, c2)
+        np.testing.assert_allclose(np.asarray(d2), np.asarray(d1),
+                                   rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="window"):
+        llama.prefill_chunked(params, tokens, cfg,
+                              llama.init_cache(cfg, 2, 16), window=3)
+    with pytest.raises(ValueError, match="overflow"):
+        # decode_chunk's scatter would silently drop out-of-bounds
+        # writes; the capacity check fails loudly instead
+        llama.prefill_chunked(params, tokens, cfg,
+                              llama.init_cache(cfg, 2, 4), window=4)
+
+
 def test_llama_tp_partition_specs_compile():
     """GSPMD tensor parallelism: jit with megatron specs over a (dp, tp)
     mesh compiles and matches the unsharded forward."""

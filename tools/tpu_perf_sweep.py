@@ -377,9 +377,12 @@ def serving_sweep():
         params, cfg, dparams, dcfg, prompt, max_new_tokens=n_new,
         draft_k=4, max_len=max_len + 8)))
 
+    # ONE batcher instance: its jitted closures are per-instance, so the
+    # warm run must hit the same object the timed run uses.
+    srv = ContinuousBatcher(params, cfg, n_slots=b, max_len=max_len,
+                            admit_width=plen)
+
     def batcher_run(n_requests, toks):
-        srv = ContinuousBatcher(params, cfg, n_slots=b, max_len=max_len,
-                                admit_width=plen)
         reqs = [Request(prompt=list(range(1, plen + 1)),
                         max_new_tokens=toks) for _ in range(n_requests)]
         return srv.run(reqs)
