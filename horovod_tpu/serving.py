@@ -52,9 +52,11 @@ def _splice(cache: KVCache, k_new: jax.Array, v_new: jax.Array,
             slot: jax.Array, length: jax.Array) -> KVCache:
     """Write a B=1 prefill's K/V window into slot ``slot`` of the pool.
 
-    k_new/v_new: [n_layers, 1, W, KVH, Dh] — the admission window (W is
-    the static admission width, so this is one compiled program for all
-    admissions).  ``length`` is the row's true prompt length.
+    k_new/v_new: [n_layers, 1, W, KVH, Dh] where W is the padded prompt
+    width (a multiple of the admission window; one compiled program per
+    distinct W).  Only the first W positions of the slot row are
+    written; ``length`` is the row's true prompt length, and positions
+    beyond it are unreadable until rewritten (write-before-read).
     """
     k = lax.dynamic_update_slice(cache.k, k_new, (0, slot, 0, 0, 0))
     v = lax.dynamic_update_slice(cache.v, v_new, (0, slot, 0, 0, 0))
@@ -65,10 +67,12 @@ class ContinuousBatcher:
     """Serve mixed-length requests through a fixed slot pool.
 
     ``n_slots`` is the compiled batch size; ``max_len`` bounds prompt +
-    generation per request; ``admit_width`` is the static prompt-padding
-    width every admission compiles against (prompts longer than it are
-    rejected).  ``greedy`` only — sampling would need per-slot PRNG
-    streams to keep the solo-equivalence property.
+    generation per request; ``admit_width`` is the admission window —
+    prompts chunk in at this width (up to the pool depth), so it sets
+    the admission activation-memory bound and the compiled-program
+    granularity, not a prompt-length limit.  ``greedy`` only — sampling
+    would need per-slot PRNG streams to keep the solo-equivalence
+    property.
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
@@ -95,10 +99,20 @@ class ContinuousBatcher:
 
         @jax.jit
         def _prefill_one(params, tokens, length):
-            cache = llama.init_cache(cfg, 1, admit_width)
+            # Chunked at the admission width: prompts up to the pool
+            # depth admit through fixed admit_width windows, so
+            # activation memory never spikes past O(admit_width·depth)
+            # and there are at most max_len/admit_width admission
+            # programs (one per window count).  The B=1 cache is sized
+            # to the padded prompt (tokens.shape[1]), so the splice
+            # moves only the K/V the prefill produced — the slot row's
+            # tail keeps the previous occupant's bytes, which the
+            # write-before-read invariant makes unreadable.
+            cache = llama.init_cache(cfg, 1, tokens.shape[1])
             cache = cache._replace(length=jnp.zeros((1,), jnp.int32))
-            logits, cache = llama.prefill(params, tokens, cfg, cache,
-                                          lengths=length)
+            logits, cache = llama.prefill_chunked(
+                params, tokens, cfg, cache, window=admit_width,
+                lengths=length)
             return logits[0], cache.k, cache.v
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -118,23 +132,28 @@ class ContinuousBatcher:
         return [i for i, b in enumerate(self._busy) if not b]
 
     def admit(self, req: Request) -> int:
-        """Prefill ``req`` into a free slot; returns the slot index."""
+        """Prefill ``req`` into a free slot (chunked at ``admit_width``
+        for prompts longer than one window); returns the slot index."""
         L = len(req.prompt)
-        if not 1 <= L <= self.admit_width:
-            raise ValueError(
-                f"prompt length {L} outside [1, admit_width="
-                f"{self.admit_width}]")
+        if L < 1:
+            raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if L + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {L} + max_new_tokens {req.max_new_tokens} "
                 f"exceeds max_len {self.max_len}")
+        w = self.admit_width
+        n_win = -(-L // w)
+        if n_win * w > self.max_len:
+            raise ValueError(
+                f"prompt {L} padded to {n_win * w} admission windows "
+                f"exceeds max_len {self.max_len}")
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slot; call step() until one opens")
         slot = free[0]
-        padded = np.zeros((1, self.admit_width), np.int32)
+        padded = np.zeros((1, n_win * w), np.int32)
         padded[0, :L] = req.prompt
         logits, k_new, v_new = self._prefill_one(
             self.params, jnp.asarray(padded), jnp.asarray([L], jnp.int32))

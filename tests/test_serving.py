@@ -69,15 +69,34 @@ def test_serving_admission_validation(world):
     cfg, params = world
     b = ContinuousBatcher(params, cfg, n_slots=1, max_len=16,
                           admit_width=4)
-    with pytest.raises(ValueError, match="admit_width"):
-        b.admit(Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=2))
     with pytest.raises(ValueError, match="max_new_tokens"):
         b.admit(Request(prompt=[1], max_new_tokens=0))
     with pytest.raises(ValueError, match="max_len"):
         b.admit(Request(prompt=[1, 2, 3], max_new_tokens=14))
+    with pytest.raises(ValueError, match="max_len"):
+        b.admit(Request(prompt=list(range(1, 16)), max_new_tokens=2))
+    # window-padding overflow: needs admit_width not dividing max_len —
+    # prompt 13 (+2 new = 15 <= 16 passes the budget check) pads to
+    # 3 windows of 6 = 18 > 16
+    b6 = ContinuousBatcher(params, cfg, n_slots=1, max_len=16,
+                           admit_width=6)
+    with pytest.raises(ValueError, match="windows"):
+        b6.admit(Request(prompt=list(range(1, 14)), max_new_tokens=2))
     b.admit(Request(prompt=[1, 2], max_new_tokens=3))
     with pytest.raises(RuntimeError, match="free slot"):
         b.admit(Request(prompt=[3], max_new_tokens=2))
+
+
+def test_serving_long_prompt_chunked_admission(world):
+    """A prompt longer than admit_width admits through multiple chunked
+    windows and still matches solo generate exactly."""
+    cfg, params = world
+    b = ContinuousBatcher(params, cfg, n_slots=1, max_len=16,
+                          admit_width=4)
+    prompt = [9, 1, 2, 3, 4, 5, 6, 7, 8, 2]         # 10 > admit_width 4
+    got = b.run([Request(prompt=prompt, max_new_tokens=4)])[0]
+    want = _solo(params, cfg, prompt, 4, 16)
+    np.testing.assert_array_equal(np.asarray(got), want)
 
 
 def test_serving_slot_reuse_no_leakage(world):
