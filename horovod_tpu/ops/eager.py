@@ -1265,6 +1265,53 @@ def negotiate_gather_sizes(shape: Sequence[int], dtype_str: str,
     return [int(all_digest[r, 2]) for r in range(n)]
 
 
+def negotiate_alltoall_splits(splits: Sequence[int], dim0: int,
+                              name: str | None = None) -> np.ndarray:
+    """Exchange per-rank alltoall split rows THROUGH the engine (so the
+    negotiation serializes with every queued op, like
+    :func:`negotiate_gather_sizes`) and return the full [n, n] matrix —
+    ``S[r, j]`` = rows rank r sends to rank j.  Every rank derives the
+    same padding (``S.max()``) and its own receive column from it.
+
+    Validation that depends on a rank's OWN values (row length,
+    negativity, sum == its dim 0) happens AFTER the exchange, against
+    the gathered matrix, so a bad rank raises the same error on every
+    rank instead of deadlocking the others in the negotiation (the
+    :func:`negotiate_gather_sizes` discipline)."""
+    n = basics.size()
+    row = np.asarray(list(splits), np.int64)
+    if row.shape != (n,):
+        # A wrong-LENGTH row can't be exchanged at the fixed wire shape
+        # at all — this is a local programming error, same on any rank
+        # that makes it.
+        raise ValueError(
+            f"alltoall splits must have one entry per rank "
+            f"({n}), got shape {row.shape}")
+    rec = np.concatenate([
+        np.clip(row, -0x80000000, 0x7FFFFFFF),
+        [min(dim0, 0x7FFFFFFF)],
+    ]).astype(np.int32)[None]
+    if n == 1:
+        g = jax.device_put(rec, basics.rank_sharding())
+    else:
+        g = jax.make_array_from_process_local_data(
+            basics.rank_sharding(), rec)
+    h = allgather_async(g, name=None if name is None else f"{name}.splits")
+    allrec = np.asarray(
+        jax.device_get(synchronize(h))).reshape(n, n + 1)
+    mat, dims = allrec[:, :n].astype(np.int64), allrec[:, n]
+    for r in range(n):
+        if (mat[r] < 0).any():
+            raise ValueError(
+                f"alltoall splits must be non-negative; rank {r} sent "
+                f"{mat[r].tolist()}")
+        if mat[r].sum() != dims[r]:
+            raise ValueError(
+                f"alltoall splits sum {int(mat[r].sum())} != tensor "
+                f"dim 0 {int(dims[r])} on rank {r}")
+    return mat.astype(np.int32)
+
+
 def alltoall_async(tensor, name: str | None = None) -> int:
     """Async all-to-all (the hvd.alltoall API Horovod grew in 0.20, equal
     splits): rank r's row of the rank-major input is split into ``size``

@@ -355,21 +355,50 @@ def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name))
 
 
-def alltoall_async(tensor, name=None) -> int:
-    """Async all-to-all with equal splits (hvd.alltoall_async, Horovod
-    ≥0.20): this process's tensor splits into ``size`` chunks along dim 0;
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    """Async all-to-all (hvd.alltoall_async, Horovod ≥0.20): this
+    process's tensor splits into ``size`` chunks along dim 0;
     ``synchronize`` returns chunk ``rank`` from every process,
     concatenated.  The result is RANK-MAJOR (per-rank rows differ), so
     ``synchronize`` extracts this process's row instead of device_get-ing
     the whole array (which would fail on non-addressable multi-host
-    shards) — flagged via the handle's post payload."""
-    h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
-    _attach_post(h, rank_major=True)
+    shards) — flagged via the handle's post payload.
+
+    ``splits`` [size]: Horovod's unequal-split form (same parameter
+    order as ``horovod.torch.alltoall(tensor, splits=None, name=None)``)
+    — entry j is how many dim-0 rows go to rank j (sum = this tensor's
+    dim 0; ranks may disagree).  The split matrix is negotiated through
+    the engine — with sum-vs-dim0 validation AFTER the exchange, so a
+    bad rank errors on every rank instead of deadlocking the rest —
+    every chunk pads to the global max on the wire (the ragged-allgather
+    pad-to-max strategy), one equal all-to-all moves it, and
+    ``synchronize`` slices each sender's true chunk back out."""
+    if splits is None:
+        h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
+        _attach_post(h, rank_major=True)
+        return _note_wire_dtype(h, tensor)
+    torch = _torch()
+    n = _basics.size()
+    sp = [int(s) for s in (splits.tolist() if hasattr(splits, "tolist")
+                           else splits)]
+    local = tensor.detach().cpu()
+    S = _eager.negotiate_alltoall_splits(sp, local.shape[0],
+                                         name=name)   # [n, n]
+    maxc = max(1, int(S.max()))
+    padded = torch.zeros((n * maxc,) + tuple(local.shape[1:]),
+                         dtype=local.dtype)
+    off = 0
+    for j in range(n):
+        padded[j * maxc:j * maxc + sp[j]] = local[off:off + sp[j]]
+        off += sp[j]
+    h = _eager.alltoall_async(_to_rank_major(padded), name=name)
+    _attach_post(h, rank_major=True,
+                 a2av=(maxc, [int(c) for c in S[:, _basics.rank()]]))
     return _note_wire_dtype(h, tensor)
 
 
-def alltoall(tensor, name=None):
-    return synchronize(alltoall_async(tensor, name))
+def alltoall(tensor, splits=None, name=None):
+    return synchronize(alltoall_async(tensor, splits=splits, name=name))
 
 
 def reducescatter_async(tensor, name=None, *, op=None) -> int:
@@ -540,6 +569,14 @@ def synchronize(handle: int):
         out = _np_to_torch(local)
     else:
         out = _to_torch(raw)
+    a2av = post.get("a2av")
+    if a2av is not None:
+        # unequal-split alltoall: row layout is [sender s at s·maxc, its
+        # true chunk is the first recv[s] rows of that window]
+        maxc, recv = a2av
+        parts = [out[s * maxc:s * maxc + c] for s, c in enumerate(recv)]
+        out = (torch.cat(parts, 0).clone() if any(recv)
+               else out[:0].clone())
     x64r = post.get("x64_reduce")
     if x64r is not None:
         op, want_dtype, shape = x64r

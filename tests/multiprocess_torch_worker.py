@@ -95,6 +95,35 @@ def main() -> None:
                             name="t.a2a.async")
     assert torch.allclose(hvd.synchronize(ah), want_a2a)
 
+    # --- alltoall with UNEQUAL splits (Horovod's splits= form): rank 0
+    # sends [1, 3] of its 4 rows, rank 1 sends [2, 0] of its 2 rows.
+    v_in = (torch.arange(4, dtype=torch.float32) if me == 0
+            else torch.arange(2, dtype=torch.float32) + 100)
+    v_sp = [1, 3] if me == 0 else [2, 0]
+    v = hvd.alltoall(v_in, name="t.a2av", splits=v_sp)
+    # rank0 receives: 0→0 rows [0], 1→0 rows [100,101] → [0, 100, 101]
+    # rank1 receives: 0→1 rows [1,2,3], 1→1 none     → [1, 2, 3]
+    want_v = (torch.tensor([0.0, 100.0, 101.0]) if me == 0
+              else torch.tensor([1.0, 2.0, 3.0]))
+    assert torch.equal(v, want_v), (me, v)
+    # async form + a zero-receive rank is fine (2-D payload too)
+    z_in = (torch.zeros((0, 3)) if me == 0
+            else torch.ones((2, 3)))
+    z_sp = [0, 0] if me == 0 else [0, 2]
+    zh = hvd.alltoall_async(z_in, name="t.a2av.z", splits=z_sp)
+    z = hvd.synchronize(zh)
+    want_z = torch.zeros((0, 3)) if me == 0 else torch.ones((2, 3))
+    assert z.shape == want_z.shape and torch.equal(z, want_z), (me, z)
+    # splits-sum mismatch raises the SAME error on every rank, even when
+    # only one rank's splits are bad (validation happens after the
+    # negotiation exchange, so good ranks don't deadlock waiting)
+    bad_sp = [1, 1] if me == 0 else [1, 2]      # rank 0 sums 2 != 3
+    try:
+        hvd.alltoall(torch.zeros(3), name="t.a2av.bad", splits=bad_sp)
+        raise AssertionError("bad splits sum not detected")
+    except ValueError as e:
+        assert "splits sum" in str(e) and "rank 0" in str(e), (me, e)
+
     # --- reducescatter (Horovod ≥0.21 API): tensors reduce across ranks
     # and this process keeps shard rank() along dim 0.
     rs = hvd.reducescatter(torch.arange(4, dtype=torch.float32) + me,
