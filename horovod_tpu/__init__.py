@@ -68,6 +68,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     allreduce_async,
     alltoall,
     alltoall_async,
+    barrier,
     broadcast,
     broadcast_async,
     engine_stats,

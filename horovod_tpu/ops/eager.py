@@ -69,6 +69,11 @@ class _PendingOp:
     group_id: int | None = None            # caller-delimited fusion group
     process_set: Any = None                # ProcessSet restricting the op
     no_fuse: bool = False                  # never share a fusion bucket
+    # May a JOINED rank satisfy this op with identity (zero) inputs?
+    # True for ordinary data allreduces (hvd.join semantics); False for
+    # rendezvous ops like barrier, whose whole point is that every rank
+    # actually arrives.
+    join_identity: bool = True
     enqueued_at: float = 0.0
 
 
@@ -472,7 +477,8 @@ class EagerEngine:
         from horovod_tpu import native
 
         if (p.kind == "allreduce" and p.process_set is None
-                and p.compression is Compression.none):
+                and p.compression is Compression.none
+                and p.join_identity):
             if p.op is Sum:
                 return native.OP_PLAIN_SUM
             if p.op is Average:
@@ -1016,6 +1022,7 @@ def allreduce_async(
     group_id: int | None = None,
     process_set=None,
     no_fuse: bool = False,
+    join_identity: bool = True,
 ) -> int:
     """Async all-reduce of a rank-major tensor; returns a handle
     (reference horovod/torch/mpi_ops.py:156-176).  ``process_set``
@@ -1026,13 +1033,14 @@ def allreduce_async(
     eng, pending = _prepare_allreduce(
         tensor, average, name, op=op, compression=compression,
         group_id=group_id, process_set=process_set, no_fuse=no_fuse,
+        join_identity=join_identity,
     )
     eng.enqueue(pending)
     return pending.handle
 
 
 def _prepare_allreduce(tensor, average, name, *, op, compression, group_id,
-                       process_set, no_fuse):
+                       process_set, no_fuse, join_identity=True):
     """Build (engine, ready-to-enqueue _PendingOp) — shared by the per-op
     async path and the atomic grouped path."""
     if average is not None:
@@ -1051,6 +1059,7 @@ def _prepare_allreduce(tensor, average, name, *, op, compression, group_id,
         group_id=group_id,
         process_set=process_set,
         no_fuse=no_fuse,
+        join_identity=join_identity,
     )
 
 
@@ -1337,6 +1346,37 @@ def alltoall_async(tensor, name: str | None = None) -> int:
 
 def alltoall(tensor, name: str | None = None):
     return synchronize(alltoall_async(tensor, name))
+
+
+def barrier(name: str | None = None) -> None:
+    """Process-level barrier (the hvd.barrier API Horovod grew in 0.23):
+    returns only after every rank has entered it.  Implemented as a
+    1-element Sum allreduce drained through the engine, so it also
+    serializes with every eager op enqueued before it — reaching the
+    barrier means every prior collective on every rank has been matched
+    and dispatched."""
+    n = basics.size()
+    # this process contributes one row per mesh device it owns: [1, 1]
+    # in the one-process-per-chip world, [n, 1] single-controller
+    mine = sum(1 for d in basics.mesh().devices.flat
+               if d.process_index == jax.process_index())
+    rows = np.ones((mine, 1), np.float32)
+    if mine == n:
+        g = jax.device_put(rows, basics.rank_sharding())
+    else:
+        g = jax.make_array_from_process_local_data(
+            basics.rank_sharding(), rows)
+    out = synchronize(allreduce_async(
+        g, op=Sum, name=name or _auto_name("barrier"),
+        # a rendezvous must not be satisfiable by a joined rank's zero
+        # phantom (hvd.join would quietly turn the barrier into n-1
+        # arrivals); OP_OTHER classification makes the controller error
+        # it cleanly instead.  no_fuse keeps its dispatch self-contained.
+        no_fuse=True, join_identity=False))
+    total = float(np.asarray(jax.device_get(out))[0])
+    if total != float(n):          # engine invariant, not user error
+        raise HorovodInternalError(
+            f"barrier saw contribution sum {total} != world size {n}")
 
 
 def reducescatter_async(tensor, name: str | None = None, *,

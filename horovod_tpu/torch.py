@@ -401,6 +401,14 @@ def alltoall(tensor, splits=None, name=None):
     return synchronize(alltoall_async(tensor, splits=splits, name=name))
 
 
+def barrier(name=None) -> None:
+    """Process-level barrier (hvd.barrier, Horovod ≥0.23): returns only
+    after every process has entered it; also drains this process's
+    queued eager ops (they must match before the barrier's own
+    collective can)."""
+    _eager.barrier(name)
+
+
 def reducescatter_async(tensor, name=None, *, op=None) -> int:
     """Async reduce-scatter on torch tensors (the hvd.reducescatter API
     Horovod grew in 0.21): ranks' tensors are averaged (Horovod's default)

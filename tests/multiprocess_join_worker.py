@@ -60,6 +60,14 @@ def main() -> None:
             raise AssertionError("broadcast while joined did not error")
         except RuntimeError as e:
             assert "join" in str(e), e
+        # barrier is a rendezvous, NOT a joinable data op: a joined
+        # rank's zero phantom must not stand in for its arrival, so the
+        # controller errors it cleanly instead of reporting n-1 arrivals
+        try:
+            hvd.barrier(name="j.barrier")
+            raise AssertionError("barrier while joined did not error")
+        except (RuntimeError, ValueError) as e:
+            assert "join" in str(e), e
         last2 = hvd.join()
         assert last2 == 1, last2
 

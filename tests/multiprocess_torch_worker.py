@@ -124,6 +124,9 @@ def main() -> None:
     except ValueError as e:
         assert "splits sum" in str(e) and "rank 0" in str(e), (me, e)
 
+    # --- barrier (Horovod ≥0.23 API): all processes rendezvous.
+    hvd.barrier(name="t.barrier")
+
     # --- reducescatter (Horovod ≥0.21 API): tensors reduce across ranks
     # and this process keeps shard rank() along dim 0.
     rs = hvd.reducescatter(torch.arange(4, dtype=torch.float32) + me,

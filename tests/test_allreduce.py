@@ -265,3 +265,16 @@ def test_eager_engine_thread_safety_stress():
         tid, j = int(name.split(".")[1]), int(name.split(".")[2])
         want = sum(r + tid + j for r in range(n))
         np.testing.assert_allclose(val, want, err_msg=name)
+
+
+def test_barrier():
+    """hvd.barrier() (Horovod >=0.23 API): completes on the sim world and
+    serializes with queued eager ops (the async op before it must have
+    been matched for the barrier's own collective to run)."""
+    h = hvd.allreduce_async(hvd.per_rank(lambda r: jnp.full((4,), float(r))),
+                            name="pre.barrier")
+    hvd.barrier()
+    assert hvd.poll(h)            # matched + dispatched before the barrier
+    np.testing.assert_allclose(
+        np.asarray(hvd.synchronize(h)),
+        np.full((4,), float(sum(range(hvd.size())))))
