@@ -1224,54 +1224,79 @@ def negotiate_gather_sizes(shape: Sequence[int], dtype_str: str,
     (consistent within a frontend: every rank runs the same one).  Raises
     the same clean errors for ndim/dtype/trailing-dim mismatch on every
     rank.  Used by the torch and keras frontends."""
+    return negotiate_gather_sizes_many([shape], [dtype_str], name)[0]
+
+
+def negotiate_gather_sizes_many(
+    shapes: Sequence[Sequence[int]], dtype_strs: Sequence[str],
+    name: str | None = None,
+) -> list[list[int]]:
+    """Batched :func:`negotiate_gather_sizes`: K members' digests ride ONE
+    engine allgather (one control-plane round-trip however many tensors a
+    grouped call carries), validated member-by-member with the same
+    symmetric errors."""
     import zlib
 
-    ndim = len(shape)
-    if ndim < 1:
-        raise ValueError("allgather expects a tensor with >= 1 dim")
-    if ndim > MAX_GATHER_NDIM:
-        raise ValueError(
-            f"allgather supports up to {MAX_GATHER_NDIM} dims, got {ndim}"
-        )
-    # int32 end-to-end: jax's default x64-truncation would silently fold
-    # int64 digests and break the cross-rank comparison.  Dims that don't
-    # fit int32 would wrap silently, so reject them up front.
-    if any(d > 0x7FFFFFFF for d in shape):
-        raise ValueError(
-            "allgather: tensor dims must fit in int32 for the cross-rank "
-            f"shape negotiation; got shape {tuple(shape)}"
-        )
-    digest = np.zeros((2 + MAX_GATHER_NDIM,), np.int32)
-    digest[0] = ndim
-    # crc32, not hash(): Python's str hash is per-process randomized.
-    digest[1] = zlib.crc32(dtype_str.encode()) & 0x7FFFFFFF
-    digest[2:2 + ndim] = list(shape)
+    k = len(shapes)
+    digest = np.zeros((k, 2 + MAX_GATHER_NDIM), np.int32)
+    crcs = []
+    for i, (shape, dtype_str) in enumerate(zip(shapes, dtype_strs)):
+        ndim = len(shape)
+        if ndim < 1:
+            raise ValueError("allgather expects a tensor with >= 1 dim")
+        if ndim > MAX_GATHER_NDIM:
+            raise ValueError(
+                f"allgather supports up to {MAX_GATHER_NDIM} dims, "
+                f"got {ndim}"
+            )
+        # int32 end-to-end: jax's default x64-truncation would silently
+        # fold int64 digests and break the cross-rank comparison.  Dims
+        # that don't fit int32 would wrap silently, so reject up front.
+        if any(d > 0x7FFFFFFF for d in shape):
+            raise ValueError(
+                "allgather: tensor dims must fit in int32 for the "
+                f"cross-rank shape negotiation; got shape {tuple(shape)}"
+            )
+        digest[i, 0] = ndim
+        # crc32, not hash(): Python's str hash is per-process randomized.
+        crc = zlib.crc32(dtype_str.encode()) & 0x7FFFFFFF
+        crcs.append(crc)
+        digest[i, 1] = crc
+        digest[i, 2:2 + ndim] = list(shape)
     n = basics.size()
+    flat = digest.reshape(1, -1)
     if n == 1:
-        g = jax.device_put(digest[None], basics.rank_sharding())
+        g = jax.device_put(flat, basics.rank_sharding())
     else:
         g = jax.make_array_from_process_local_data(
-            basics.rank_sharding(), digest[None]
+            basics.rank_sharding(), flat
         )
     h = allgather_async(g, name=None if name is None else f"{name}.shapes")
     all_digest = np.asarray(
         jax.device_get(synchronize(h))
-    ).reshape(n, 2 + MAX_GATHER_NDIM)
-    for r in range(n):
-        if all_digest[r, 0] != ndim or all_digest[r, 1] != digest[1]:
-            raise ValueError(
-                "allgather: per-rank tensors must share ndim and dtype; "
-                f"rank {r} disagrees ({all_digest[r, :2].tolist()} vs "
-                f"{digest[:2].tolist()})"
-            )
-        if list(all_digest[r, 3:2 + ndim]) != list(shape[1:]):
-            raise ValueError(
-                "allgather: per-rank tensors must agree on all dims except "
-                f"dim 0; rank {r} has trailing "
-                f"{all_digest[r, 3:2 + ndim].tolist()} vs local "
-                f"{list(shape[1:])}"
-            )
-    return [int(all_digest[r, 2]) for r in range(n)]
+    ).reshape(n, k, 2 + MAX_GATHER_NDIM)
+    out: list[list[int]] = []
+    for i, shape in enumerate(shapes):
+        ndim = len(shape)
+        member = f" (group member {i})" if k > 1 else ""
+        for r in range(n):
+            if (all_digest[r, i, 0] != ndim
+                    or all_digest[r, i, 1] != crcs[i]):
+                raise ValueError(
+                    "allgather: per-rank tensors must share ndim and "
+                    f"dtype; rank {r} disagrees{member} "
+                    f"({all_digest[r, i, :2].tolist()} vs "
+                    f"{[ndim, crcs[i]]})"
+                )
+            if list(all_digest[r, i, 3:2 + ndim]) != list(shape[1:]):
+                raise ValueError(
+                    "allgather: per-rank tensors must agree on all dims "
+                    f"except dim 0; rank {r} has trailing{member} "
+                    f"{all_digest[r, i, 3:2 + ndim].tolist()} vs local "
+                    f"{list(shape[1:])}"
+                )
+        out.append([int(all_digest[r, i, 2]) for r in range(n)])
+    return out
 
 
 def negotiate_alltoall_splits(splits: Sequence[int], dim0: int,

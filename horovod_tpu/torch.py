@@ -332,23 +332,29 @@ def _negotiate_gather_shapes(tensor, name):
     return local, sizes
 
 
-def allgather_async(tensor, name=None) -> int:
-    """Async allgather along dim 0; ranks may disagree on dim 0 (the
-    reference's unequal-first-dim allgather, operations.cc:841-901).
-    Sizes are negotiated through the engine up front; ``synchronize``
-    returns the ragged concatenation."""
+def _pad_and_gather_async(local, sizes, name, orig) -> int:
+    """Pad a CPU tensor to the negotiated max dim 0 and enqueue the
+    ragged allgather — the one wire path both the single-op and grouped
+    allgathers share (the engine slices the concatenation via sizes=)."""
     torch = _torch()
-    local, sizes = _negotiate_gather_shapes(tensor, name)
     pad = max(sizes)
     if local.shape[0] != pad:
         padded = torch.zeros((pad,) + tuple(local.shape[1:]),
                              dtype=local.dtype)
         padded[:local.shape[0]] = local
         local = padded
-    # The engine slices the ragged concatenation itself (sizes=).
     h = _eager.allgather_async(_to_rank_major(local), name=name,
                                sizes=sizes)
-    return _note_wire_dtype(h, tensor)
+    return _note_wire_dtype(h, orig)
+
+
+def allgather_async(tensor, name=None) -> int:
+    """Async allgather along dim 0; ranks may disagree on dim 0 (the
+    reference's unequal-first-dim allgather, operations.cc:841-901).
+    Sizes are negotiated through the engine up front; ``synchronize``
+    returns the ragged concatenation."""
+    local, sizes = _negotiate_gather_shapes(tensor, name)
+    return _pad_and_gather_async(local, sizes, name, tensor)
 
 
 def allgather(tensor, name=None):
@@ -544,6 +550,45 @@ def grouped_allreduce(tensors, average=True, *, op=None,
         else:
             results.append(_to_torch(next(it)))
     return results
+
+
+def grouped_allgather(tensors, name=None):
+    """Allgather many tensors together (the grouped API Horovod grew in
+    0.28): ALL members' shape digests ride one engine negotiation (one
+    control-plane round-trip, not one per member), then every async
+    enqueues back-to-back — one deterministic engine sequence on every
+    rank — and they complete together.  Ragged first dims follow the
+    single-op semantics per member."""
+    prefix = name or "grouped_allgather"
+    locals_ = [t.detach().cpu() for t in tensors]
+    sizes_per = _eager.negotiate_gather_sizes_many(
+        [tuple(t.shape) for t in locals_],
+        [str(t.dtype) for t in locals_],       # same convention as the
+        name=prefix,                           # single-op negotiation
+    )
+    handles = [
+        _pad_and_gather_async(local, sizes, f"{prefix}.{i}", tensors[i])
+        for i, (local, sizes) in enumerate(zip(locals_, sizes_per))
+    ]
+    return [synchronize(h) for h in handles]
+
+
+def grouped_reducescatter(tensors, name=None, *, op=None):
+    """Reduce-scatter many tensors together (grouped API, Horovod ≥0.28):
+    every member validates BEFORE any enqueues (a bad member can't strand
+    earlier members' handles), then back-to-back asyncs complete
+    together; each member keeps this process's reduced shard, default
+    Average like ``reducescatter``."""
+    n = _basics.size()
+    for i, t in enumerate(tensors):
+        if t.dim() < 1 or t.shape[0] % n != 0:
+            raise ValueError(
+                f"grouped_reducescatter member {i}: dim 0 must be "
+                f"divisible by size={n}; got shape {tuple(t.shape)}")
+    prefix = name or "grouped_reducescatter"
+    handles = [reducescatter_async(t, name=f"{prefix}.{i}", op=op)
+               for i, t in enumerate(tensors)]
+    return [synchronize(h) for h in handles]
 
 
 def poll(handle: int) -> bool:

@@ -127,6 +127,22 @@ def main() -> None:
     # --- barrier (Horovod ≥0.23 API): all processes rendezvous.
     hvd.barrier(name="t.barrier")
 
+    # --- grouped allgather / reducescatter (Horovod ≥0.28 APIs): many
+    # tensors, one deterministic engine sequence, results per member.
+    ga = hvd.grouped_allgather(
+        [torch.full((me + 1, 2), float(me)),     # ragged member
+         torch.tensor([float(me)])])
+    assert ga[0].shape == (3, 2) and torch.allclose(
+        ga[0], torch.tensor([[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]])), ga[0]
+    assert torch.allclose(ga[1], torch.tensor([0.0, 1.0])), ga[1]
+    grs = hvd.grouped_reducescatter(
+        [torch.arange(4, dtype=torch.float32) + me,
+         torch.full((2,), 2.0 * me)], op=hvd.Sum)
+    want0 = (torch.tensor([1.0, 3.0]) if me == 0
+             else torch.tensor([5.0, 7.0]))
+    assert torch.allclose(grs[0], want0), grs[0]
+    assert torch.allclose(grs[1], torch.tensor([2.0])), grs[1]
+
     # --- reducescatter (Horovod ≥0.21 API): tensors reduce across ranks
     # and this process keeps shard rank() along dim 0.
     rs = hvd.reducescatter(torch.arange(4, dtype=torch.float32) + me,
