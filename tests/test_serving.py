@@ -164,3 +164,31 @@ def test_speculative_ragged_prompts(world):
         params, cfg, params, cfg, prompt, max_new_tokens=n_new,
         draft_k=3, prompt_lengths=lengths))
     np.testing.assert_array_equal(got, want)
+
+
+def test_serving_randomized_stream_matches_solo(world):
+    """Chaos oracle: a seeded random request stream (mixed lengths incl.
+    multi-window prompts, mixed budgets, random EOS) served through a
+    2-slot pool — every result must equal solo generate with the same
+    EOS truncation applied."""
+    cfg, params = world
+    rng = np.random.RandomState(1234)
+    b = ContinuousBatcher(params, cfg, n_slots=2, max_len=24,
+                          admit_width=4)
+    reqs = []
+    for _ in range(8):
+        plen = int(rng.randint(1, 11))
+        prompt = [int(t) for t in rng.randint(0, cfg.vocab_size, plen)]
+        budget = int(rng.randint(1, min(7, 24 - plen)))
+        eos = int(rng.randint(0, cfg.vocab_size)) if rng.rand() < 0.3 \
+            else None
+        reqs.append(Request(prompt=prompt, max_new_tokens=budget,
+                            eos_id=eos))
+    results = b.run(reqs)
+    assert len(results) == len(reqs)
+    for req, got in zip(reqs, results):
+        solo = _solo(params, cfg, req.prompt, req.max_new_tokens, 24)
+        want = list(solo)
+        if req.eos_id is not None and req.eos_id in want:
+            want = want[: want.index(req.eos_id) + 1]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
