@@ -40,11 +40,18 @@ from horovod_tpu.models.llama import KVCache
 
 @dataclasses.dataclass
 class Request:
-    """One generation request: prompt token ids + a new-token budget."""
+    """One generation request: prompt token ids + a new-token budget.
+
+    ``sample_key``: PRNG key for sampled decoding (required when the
+    batcher's ``temperature > 0``).  The slot replays exactly the key
+    schedule solo ``generate(key=sample_key)`` uses — ``split(key,
+    max_new_tokens)[i]`` for the i-th new token — so a sampled request's
+    tokens equal its solo run draw for draw."""
 
     prompt: list[int]
     max_new_tokens: int
     eos_id: int | None = None
+    sample_key: Any = None
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -70,13 +77,19 @@ class ContinuousBatcher:
     generation per request; ``admit_width`` is the admission window —
     prompts chunk in at this width (up to the pool depth), so it sets
     the admission activation-memory bound and the compiled-program
-    granularity, not a prompt-length limit.  ``greedy`` only — sampling
-    would need per-slot PRNG streams to keep the solo-equivalence
-    property.
+    granularity, not a prompt-length limit.
+
+    ``temperature``/``top_k``/``top_p`` are pool-level sampling knobs
+    (one compiled tick for every slot).  With ``temperature > 0`` each
+    request carries its own ``sample_key`` and every slot draws from its
+    own PRNG stream on solo ``generate``'s exact key schedule — sampled
+    results stay draw-for-draw equal to running each request alone.
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
-                 n_slots: int, max_len: int, admit_width: int):
+                 n_slots: int, max_len: int, admit_width: int,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None):
         if admit_width > max_len:
             raise ValueError(
                 f"admit_width {admit_width} > max_len {max_len}: the "
@@ -86,6 +99,7 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.max_len = max_len
         self.admit_width = admit_width
+        self.temperature = float(temperature)
         self.cache = llama.init_cache(cfg, n_slots, max_len)
         # ragged from birth: every row owns its position
         self.cache = self.cache._replace(
@@ -96,6 +110,13 @@ class ContinuousBatcher:
         self._budget = [0] * n_slots
         self._eos = [None] * n_slots
         self._out: list[list[int]] = [[] for _ in range(n_slots)]
+        # per-slot key schedules (sampling): slot s's next draw uses
+        # _keys[s][len(_out[s])] — exactly solo generate's split schedule.
+        # All schedules are canonicalized to typed keys at admit, so the
+        # free-slot dummy always stacks with them.
+        self._keys: list[Any] = [None] * n_slots
+        self._dummy_key = jax.random.key(0)
+        self._greedy_keys = jnp.stack([self._dummy_key] * n_slots)
 
         @jax.jit
         def _prefill_one(params, tokens, length):
@@ -116,10 +137,18 @@ class ContinuousBatcher:
             return logits[0], cache.k, cache.v
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def _tick(params, cache, last_logits):
+        def _tick(params, cache, last_logits, keys):
             # donation matters here: without it every tick copies the
             # whole pool K/V (decode's cost IS cache traffic)
-            tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            if temperature > 0.0:
+                # per-row [1, V] sampling with that row's own key — the
+                # same call shape solo generate's sample_logits sees, so
+                # draws are bit-identical to the solo run
+                tok = jax.vmap(lambda l, k: llama.sample_logits(
+                    l[None], k, temperature=temperature, top_k=top_k,
+                    top_p=top_p)[0])(last_logits, keys).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             logits, cache = llama.decode_step(params, tok, cfg, cache)
             return tok, logits, cache
 
@@ -139,6 +168,12 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature > 0.0 and req.sample_key is None:
+            # validated BEFORE any state changes: a rejected admission
+            # must not leave the slot busy or spliced
+            raise ValueError(
+                "sampling batcher (temperature > 0) needs a sample_key "
+                "on every Request")
         if L + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {L} + max_new_tokens {req.max_new_tokens} "
@@ -165,6 +200,17 @@ class ContinuousBatcher:
         self._budget[slot] = req.max_new_tokens
         self._eos[slot] = req.eos_id
         self._out[slot] = []
+        if self.temperature > 0.0:
+            # canonicalize legacy uint32 [2] keys to typed (same key
+            # data → same split children → same draws), so per-slot
+            # schedules and the free-slot dummy always stack together
+            key = req.sample_key
+            if not jax.dtypes.issubdtype(
+                    getattr(key, "dtype", None), jax.dtypes.prng_key):
+                key = jax.random.wrap_key_data(
+                    jnp.asarray(key, jnp.uint32))
+            # solo generate's schedule: one split per prospective token
+            self._keys[slot] = jax.random.split(key, req.max_new_tokens)
         return slot
 
     # -- decode ------------------------------------------------------------
@@ -172,8 +218,18 @@ class ContinuousBatcher:
     def step(self) -> dict[int, list[int]]:
         """Advance every slot one token; returns {slot: tokens} for
         requests that finished on this tick."""
+        if self.temperature > 0.0:
+            keys = jnp.stack([
+                self._keys[s][len(self._out[s])]
+                if (self._busy[s]
+                    and len(self._out[s]) < len(self._keys[s]))
+                else self._dummy_key
+                for s in range(self.n_slots)
+            ])
+        else:
+            keys = self._greedy_keys      # constant; _tick ignores it
         tok, self.last_logits, self.cache = self._tick(
-            self.params, self.cache, self.last_logits)
+            self.params, self.cache, self.last_logits, keys)
         done: dict[int, list[int]] = {}
         tok_host = np.asarray(tok)
         for slot in range(self.n_slots):

@@ -192,3 +192,59 @@ def test_serving_randomized_stream_matches_solo(world):
         if req.eos_id is not None and req.eos_id in want:
             want = want[: want.index(req.eos_id) + 1]
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_serving_sampled_matches_solo_generate(world):
+    """A sampling batcher (temperature/top_k/top_p + per-request keys)
+    reproduces solo generate's draws exactly: slots replay the same
+    split-key schedule at the same [1, V] call shape."""
+    cfg, params = world
+    temp, tk, tp = 0.8, 50, 0.95
+    b = ContinuousBatcher(params, cfg, n_slots=2, max_len=16,
+                          admit_width=4, temperature=temp, top_k=tk,
+                          top_p=tp)
+    reqs = [
+        Request(prompt=[5, 17, 42], max_new_tokens=4,
+                sample_key=jax.random.key(7)),
+        Request(prompt=[9, 1], max_new_tokens=6,
+                sample_key=jax.random.key(8)),
+        Request(prompt=[3, 3, 3, 3, 3], max_new_tokens=3,
+                sample_key=jax.random.key(9)),
+    ]
+    results = b.run(reqs)
+    for req, got in zip(reqs, results):
+        solo = np.asarray(llama.generate(
+            params, jnp.asarray([req.prompt], jnp.int32), cfg,
+            max_new_tokens=req.max_new_tokens, max_len=16,
+            temperature=temp, top_k=tk, top_p=tp, key=req.sample_key,
+        ))[0]
+        np.testing.assert_array_equal(np.asarray(got), solo)
+    with pytest.raises(ValueError, match="sample_key"):
+        b.admit(Request(prompt=[1], max_new_tokens=2))
+
+
+def test_serving_sampled_legacy_keys_and_free_slot_mix(world):
+    """Legacy PRNGKey sample keys canonicalize to the same draws, and a
+    free slot mid-serving (dummy key stacking with real schedules) works
+    — the first-completion crash case."""
+    cfg, params = world
+    b = ContinuousBatcher(params, cfg, n_slots=2, max_len=16,
+                          admit_width=4, temperature=0.7)
+    reqs = [
+        Request(prompt=[5, 17], max_new_tokens=2,     # finishes first →
+                sample_key=jax.random.PRNGKey(21)),   # slot goes free
+        Request(prompt=[9, 1, 4], max_new_tokens=6,
+                sample_key=jax.random.PRNGKey(22)),
+    ]
+    results = b.run(reqs)
+    for req, got in zip(reqs, results):
+        solo = np.asarray(llama.generate(
+            params, jnp.asarray([req.prompt], jnp.int32), cfg,
+            max_new_tokens=req.max_new_tokens, max_len=16,
+            temperature=0.7, key=req.sample_key,
+        ))[0]
+        np.testing.assert_array_equal(np.asarray(got), solo)
+    # a rejected admission leaves no slot busy
+    with pytest.raises(ValueError, match="sample_key"):
+        b.admit(Request(prompt=[1], max_new_tokens=2))
+    assert b.free_slots() == [0, 1]
