@@ -46,12 +46,59 @@ class Request:
     batcher's ``temperature > 0``).  The slot replays exactly the key
     schedule solo ``generate(key=sample_key)`` uses — ``split(key,
     max_new_tokens)[i]`` for the i-th new token — so a sampled request's
-    tokens equal its solo run draw for draw."""
+    tokens equal its solo run draw for draw.
+
+    ``prefix``: a :class:`PrefixCache` (shared system prompt) this
+    request continues from; ``prompt`` is then just the suffix (the user
+    turn) and the prefix's K/V are spliced instead of recomputed."""
 
     prompt: list[int]
     max_new_tokens: int
     eos_id: int | None = None
     sample_key: Any = None
+    prefix: "PrefixCache | None" = None
+
+
+class PrefixCache:
+    """Precomputed K/V of a shared prompt prefix (the system-prompt
+    pattern): prefill once, splice into every admission that carries it —
+    the prefix's FLOPs are paid once per server, not once per request.
+
+    Storage: [n_layers, 1, P, KVH, Dh] K/V plus the prefix token count.
+    """
+
+    def __init__(self, k: jax.Array, v: jax.Array, length: int):
+        self.k, self.v, self.length = k, v, int(length)
+
+
+def precompute_prefix(params: dict, cfg: llama.LlamaConfig,
+                      tokens: list[int], *,
+                      window: int | None = None) -> PrefixCache:
+    """Prefill a shared prefix once → a splice-ready :class:`PrefixCache`.
+
+    ``window``: chunk the prefill (``llama.prefill_chunked``) so a
+    multi-thousand-token system prompt doesn't spike O(P²) activation
+    memory at server setup — the same bound the batcher's admissions
+    use.  The K/V buffer pads to a window multiple; ``length`` stays the
+    true token count (the pad tail is masked/overwritten downstream).
+    """
+    if not tokens:
+        raise ValueError("empty prefix")
+    p = len(tokens)
+    if window is None:
+        t = jnp.asarray([tokens], jnp.int32)
+        cache = llama.init_cache(cfg, 1, p)
+        _, cache = llama.prefill(params, t, cfg, cache)
+        return PrefixCache(cache.k, cache.v, p)
+    pad = -(-p // window) * window
+    t = np.zeros((1, pad), np.int32)
+    t[0, :p] = tokens
+    cache = llama.init_cache(cfg, 1, pad)
+    cache = cache._replace(length=jnp.zeros((1,), jnp.int32))
+    _, cache = llama.prefill_chunked(
+        params, jnp.asarray(t), cfg, cache, window=window,
+        lengths=jnp.asarray([p], jnp.int32))
+    return PrefixCache(cache.k, cache.v, p)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -136,6 +183,29 @@ class ContinuousBatcher:
                 lengths=length)
             return logits[0], cache.k, cache.v
 
+        @jax.jit
+        def _prefill_suffix(params, pk, pv, plen, tokens, length):
+            # continue from a spliced prefix: the B=1 cache starts with
+            # the prefix K/V at [0, P) and the suffix chunk-prefills
+            # from base position P (prefill_chunked's nonzero-base path).
+            # The prefix rides along in the admission window — one extra
+            # copy of its K/V per admission (suffix attention NEEDS the
+            # prefix keys in context, so a prefix-free B=1 cache can't
+            # work), still orders of magnitude below recomputing the
+            # prefill.  One compiled program per distinct (prefix width,
+            # window count) pair — servers hold few distinct prefixes.
+            w_total = pk.shape[2] + tokens.shape[1]
+            cache = llama.init_cache(cfg, 1, w_total)
+            cache = KVCache(
+                k=lax.dynamic_update_slice(cache.k, pk, (0, 0, 0, 0, 0)),
+                v=lax.dynamic_update_slice(cache.v, pv, (0, 0, 0, 0, 0)),
+                length=plen,
+            )
+            logits, cache = llama.prefill_chunked(
+                params, tokens, cfg, cache, window=admit_width,
+                lengths=length)
+            return logits[0], cache.k, cache.v
+
         @partial(jax.jit, donate_argnums=(1, 2))
         def _tick(params, cache, last_logits, keys):
             # donation matters here: without it every tick copies the
@@ -153,6 +223,7 @@ class ContinuousBatcher:
             return tok, logits, cache
 
         self._prefill_one = _prefill_one
+        self._prefill_suffix = _prefill_suffix
         self._tick = _tick
 
     # -- admission ---------------------------------------------------------
@@ -174,27 +245,37 @@ class ContinuousBatcher:
             raise ValueError(
                 "sampling batcher (temperature > 0) needs a sample_key "
                 "on every Request")
-        if L + req.max_new_tokens > self.max_len:
+        P = req.prefix.length if req.prefix is not None else 0
+        p_pad = int(req.prefix.k.shape[2]) if req.prefix is not None else 0
+        if P + L + req.max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt {L} + max_new_tokens {req.max_new_tokens} "
-                f"exceeds max_len {self.max_len}")
+                f"prefix {P} + prompt {L} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len {self.max_len}")
         w = self.admit_width
         n_win = -(-L // w)
-        if n_win * w > self.max_len:
+        if p_pad + n_win * w > self.max_len:
             raise ValueError(
-                f"prompt {L} padded to {n_win * w} admission windows "
-                f"exceeds max_len {self.max_len}")
+                f"prefix buffer {p_pad} + prompt {L} padded to "
+                f"{n_win * w} admission windows exceeds max_len "
+                f"{self.max_len}")
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slot; call step() until one opens")
         slot = free[0]
         padded = np.zeros((1, n_win * w), np.int32)
         padded[0, :L] = req.prompt
-        logits, k_new, v_new = self._prefill_one(
-            self.params, jnp.asarray(padded), jnp.asarray([L], jnp.int32))
+        if req.prefix is not None:
+            logits, k_new, v_new = self._prefill_suffix(
+                self.params, req.prefix.k, req.prefix.v,
+                jnp.asarray([P], jnp.int32), jnp.asarray(padded),
+                jnp.asarray([L], jnp.int32))
+        else:
+            logits, k_new, v_new = self._prefill_one(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([L], jnp.int32))
         self.cache = _splice(self.cache, k_new, v_new,
                              jnp.asarray(slot, jnp.int32),
-                             jnp.asarray(L, jnp.int32))
+                             jnp.asarray(P + L, jnp.int32))
         self.last_logits = self.last_logits.at[slot].set(logits)
         self._busy[slot] = True
         self._budget[slot] = req.max_new_tokens

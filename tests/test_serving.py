@@ -248,3 +248,29 @@ def test_serving_sampled_legacy_keys_and_free_slot_mix(world):
     with pytest.raises(ValueError, match="sample_key"):
         b.admit(Request(prompt=[1], max_new_tokens=2))
     assert b.free_slots() == [0, 1]
+
+
+def test_serving_prefix_cache_matches_solo(world):
+    """A shared system-prompt prefix prefilled ONCE (precompute_prefix)
+    and spliced into every admission: each request's continuation equals
+    solo generate over prefix + suffix."""
+    from horovod_tpu.serving import precompute_prefix
+
+    cfg, params = world
+    system = [42, 7, 99, 3, 18]                     # shared prefix, P=5
+    # chunked precompute (window 4 pads the buffer to 8) must behave
+    # identically to the one-shot form
+    pre = precompute_prefix(params, cfg, system, window=4)
+    b = ContinuousBatcher(params, cfg, n_slots=2, max_len=24,
+                          admit_width=4)
+    suffixes = [[5, 17], [9, 1, 4, 2, 8], [3]]      # incl. multi-window
+    reqs = [Request(prompt=s, max_new_tokens=4, prefix=pre)
+            for s in suffixes]
+    results = b.run(reqs)
+    for s, got in zip(suffixes, results):
+        want = _solo(params, cfg, system + s, 4, 24)
+        np.testing.assert_array_equal(np.asarray(got), want)
+    # capacity accounting includes the prefix
+    with pytest.raises(ValueError, match="prefix"):
+        b.admit(Request(prompt=list(range(1, 15)), max_new_tokens=6,
+                        prefix=pre))
