@@ -647,6 +647,23 @@ def sample_logits(
     """
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        key, filtered_logits(logits, temperature, top_k=top_k,
+                             top_p=top_p), axis=-1)
+
+
+def filtered_logits(
+    logits: jax.Array,
+    temperature,
+    *,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-filtered logits [B, V] — the
+    sampling math of :func:`sample_logits`, exposed so callers with a
+    TRACED temperature (e.g. per-request temperatures in the serving
+    batcher) compute bit-identical distributions.  ``temperature`` must
+    be positive (the greedy short-circuit lives in the caller)."""
     logits = logits / temperature
     v = logits.shape[-1]
     use_k = top_k is not None and top_k < v
@@ -672,7 +689,7 @@ def sample_logits(
         # top-k alone: lax.top_k gives the kth value without a full sort.
         kth = lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits >= kth, logits, NEG_INF_LOGIT)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
 
 
 NEG_INF_LOGIT = -1e30

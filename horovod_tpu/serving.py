@@ -50,13 +50,19 @@ class Request:
 
     ``prefix``: a :class:`PrefixCache` (shared system prompt) this
     request continues from; ``prompt`` is then just the suffix (the user
-    turn) and the prefix's K/V are spliced instead of recomputed."""
+    turn) and the prefix's K/V are spliced instead of recomputed.
+
+    ``temperature``: per-request override of the pool temperature.  A
+    sampling pool serves greedy requests via 0.0; the reverse is not
+    possible — a greedy pool compiles no sampling tick, so overrides > 0
+    require a sampling pool.  ``None`` inherits the pool setting."""
 
     prompt: list[int]
     max_new_tokens: int
     eos_id: int | None = None
     sample_key: Any = None
     prefix: "PrefixCache | None" = None
+    temperature: float | None = None
 
 
 class PrefixCache:
@@ -162,8 +168,10 @@ class ContinuousBatcher:
         # All schedules are canonicalized to typed keys at admit, so the
         # free-slot dummy always stacks with them.
         self._keys: list[Any] = [None] * n_slots
+        self._temps = [0.0] * n_slots
         self._dummy_key = jax.random.key(0)
         self._greedy_keys = jnp.stack([self._dummy_key] * n_slots)
+        self._zero_temps = jnp.zeros((n_slots,), jnp.float32)
 
         @jax.jit
         def _prefill_one(params, tokens, length):
@@ -207,16 +215,28 @@ class ContinuousBatcher:
             return logits[0], cache.k, cache.v
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def _tick(params, cache, last_logits, keys):
+        def _tick(params, cache, last_logits, keys, temps):
             # donation matters here: without it every tick copies the
             # whole pool K/V (decode's cost IS cache traffic)
             if temperature > 0.0:
-                # per-row [1, V] sampling with that row's own key — the
-                # same call shape solo generate's sample_logits sees, so
-                # draws are bit-identical to the solo run
-                tok = jax.vmap(lambda l, k: llama.sample_logits(
-                    l[None], k, temperature=temperature, top_k=top_k,
-                    top_p=top_p)[0])(last_logits, keys).astype(jnp.int32)
+                # per-row [1, V] sampling with that row's own key and
+                # (possibly overridden) temperature — the same math
+                # solo generate's sample_logits computes, via the shared
+                # filtered_logits, so draws are bit-identical per row;
+                # temp <= 0 rows take the greedy branch
+                def row(l, k, t):
+                    # safe divisor ONLY on the greedy branch (t <= 0);
+                    # every positive t divides exactly as solo generate
+                    # does, keeping bit-parity at any magnitude
+                    sampled = jax.random.categorical(
+                        k, llama.filtered_logits(
+                            l[None], jnp.where(t > 0.0, t, 1.0),
+                            top_k=top_k, top_p=top_p), axis=-1)[0]
+                    return jnp.where(t > 0.0, sampled,
+                                     jnp.argmax(l, axis=-1))
+
+                tok = jax.vmap(row)(last_logits, keys,
+                                    temps).astype(jnp.int32)
             else:
                 tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             logits, cache = llama.decode_step(params, tok, cfg, cache)
@@ -239,12 +259,20 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if self.temperature > 0.0 and req.sample_key is None:
-            # validated BEFORE any state changes: a rejected admission
-            # must not leave the slot busy or spliced
+        eff_temp = (self.temperature if req.temperature is None
+                    else float(req.temperature))
+        # validated BEFORE any state changes: a rejected admission must
+        # not leave the slot busy or spliced
+        if eff_temp > 0.0 and self.temperature <= 0.0:
+            # the unfixable problem first: no sample_key can make a
+            # greedy pool serve a sampled request
             raise ValueError(
-                "sampling batcher (temperature > 0) needs a sample_key "
-                "on every Request")
+                "a greedy pool compiles no sampling tick; construct the "
+                "ContinuousBatcher with temperature > 0 to serve sampled "
+                "requests (per-request temperature can still be 0)")
+        if eff_temp > 0.0 and req.sample_key is None:
+            raise ValueError(
+                "sampled request (temperature > 0) needs a sample_key")
         P = req.prefix.length if req.prefix is not None else 0
         p_pad = int(req.prefix.k.shape[2]) if req.prefix is not None else 0
         if P + L + req.max_new_tokens > self.max_len:
@@ -281,7 +309,8 @@ class ContinuousBatcher:
         self._budget[slot] = req.max_new_tokens
         self._eos[slot] = req.eos_id
         self._out[slot] = []
-        if self.temperature > 0.0:
+        self._temps[slot] = eff_temp
+        if eff_temp > 0.0:
             # canonicalize legacy uint32 [2] keys to typed (same key
             # data → same split children → same draws), so per-slot
             # schedules and the free-slot dummy always stack together
@@ -292,6 +321,8 @@ class ContinuousBatcher:
                     jnp.asarray(key, jnp.uint32))
             # solo generate's schedule: one split per prospective token
             self._keys[slot] = jax.random.split(key, req.max_new_tokens)
+        else:
+            self._keys[slot] = None
         return slot
 
     # -- decode ------------------------------------------------------------
@@ -302,15 +333,20 @@ class ContinuousBatcher:
         if self.temperature > 0.0:
             keys = jnp.stack([
                 self._keys[s][len(self._out[s])]
-                if (self._busy[s]
+                if (self._busy[s] and self._keys[s] is not None
                     and len(self._out[s]) < len(self._keys[s]))
                 else self._dummy_key
                 for s in range(self.n_slots)
             ])
+            temps = jnp.asarray([
+                self._temps[s] if self._busy[s] else 0.0
+                for s in range(self.n_slots)
+            ], jnp.float32)
         else:
-            keys = self._greedy_keys      # constant; _tick ignores it
+            keys = self._greedy_keys      # constants; _tick ignores
+            temps = self._zero_temps      # them on the greedy path
         tok, self.last_logits, self.cache = self._tick(
-            self.params, self.cache, self.last_logits, keys)
+            self.params, self.cache, self.last_logits, keys, temps)
         done: dict[int, list[int]] = {}
         tok_host = np.asarray(tok)
         for slot in range(self.n_slots):

@@ -274,3 +274,37 @@ def test_serving_prefix_cache_matches_solo(world):
     with pytest.raises(ValueError, match="prefix"):
         b.admit(Request(prompt=list(range(1, 15)), max_new_tokens=6,
                         prefix=pre))
+
+
+def test_serving_per_request_temperature(world):
+    """A sampling pool serves mixed per-request temperatures: a greedy
+    override (0.0), the pool default, and a custom value — each equal to
+    its solo generate."""
+    cfg, params = world
+    b = ContinuousBatcher(params, cfg, n_slots=2, max_len=16,
+                          admit_width=4, temperature=0.8, top_k=64)
+    reqs = [
+        Request(prompt=[5, 17, 42], max_new_tokens=4, temperature=0.0),
+        Request(prompt=[9, 1], max_new_tokens=5,
+                sample_key=jax.random.key(3)),          # pool 0.8
+        Request(prompt=[2, 4, 6], max_new_tokens=3, temperature=1.3,
+                sample_key=jax.random.key(4)),
+    ]
+    results = b.run(reqs)
+    for req, got in zip(reqs, results):
+        t = 0.8 if req.temperature is None else req.temperature
+        solo = np.asarray(llama.generate(
+            params, jnp.asarray([req.prompt], jnp.int32), cfg,
+            max_new_tokens=req.max_new_tokens, max_len=16,
+            temperature=t, top_k=64,
+            key=(req.sample_key if req.sample_key is not None
+                 else jax.random.key(0)),
+        ))[0]
+        np.testing.assert_array_equal(np.asarray(got), solo)
+    # greedy pools refuse sampled overrides up front
+    g = ContinuousBatcher(params, cfg, n_slots=1, max_len=16,
+                          admit_width=4)
+    with pytest.raises(ValueError, match="greedy pool"):
+        g.admit(Request(prompt=[1], max_new_tokens=2, temperature=0.5,
+                        sample_key=jax.random.key(1)))
+    assert g.free_slots() == [0]
