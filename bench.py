@@ -450,6 +450,57 @@ def _bench_llama_decode(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serving(hvd, on_tpu: bool) -> dict:
+    """Continuous-batching SERVING throughput (extras arm, TPU only):
+    a staggered-length request queue through the slot-recycling
+    ServeEngine vs the same workload as fixed llama.generate batches
+    (serving_scheduler.measure_throughput — both sides warmed, true
+    emitted tokens only).  serve_vs_static_ratio > 1 is the continuous
+    batching win: recycled slots skip the decode steps static batching
+    wastes draining each batch's longest row, and admission prefill
+    interleaves at chunk granularity instead of padding to the batch
+    max."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        n_slots, max_len, chunk = 2, 32, 8
+        shapes = [(4, 12), (3, 2), (9, 2), (2, 10), (5, 3), (6, 8)]
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        rng = np.random.RandomState(7)
+        shapes = [(int(rng.randint(8, 192)), int(rng.choice([4, 8, 192])))
+                  for _ in range(32)]
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(11)
+    reqs = [Request(prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size, size=pl)],
+                    max_new_tokens=new)
+            for pl, new in shapes]
+    r = measure_throughput(params, cfg, reqs, n_slots=n_slots,
+                           max_len=max_len, chunk=chunk)
+    return {
+        "serve_tokens_per_sec": round(r["serve_tokens_per_sec"], 1),
+        "serve_vs_static_ratio": round(r["serve_vs_static_ratio"], 3),
+        "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
+                        f"req{len(reqs)}"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -952,7 +1003,8 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # measured batch knee (the round's best MFU line, 0.415 on
     # 2026-08-01) — then the llama arms earlier rounds recorded, then
     # newer arms.
-    for fn in (_bench_fusion, _bench_resnet101_big_batch,
+    for fn in (_bench_fusion, _bench_serving,
+               _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):
         if time.monotonic() - _T_START > budget_s:

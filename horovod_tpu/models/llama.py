@@ -565,6 +565,194 @@ def decode_chunk(
     return logits, KVCache(k=ks, v=vs, length=pos + t)
 
 
+# ---------------------------------------------------------------------------
+# Block/paged KV cache (vLLM/PagedAttention layout, SOSP '23).
+#
+# The dense KVCache above reserves a full [B, max_len] stripe per slot; a
+# serving pool that recycles slots wants cache memory to follow the LIVE
+# requests instead.  Here K/V live in a pool of fixed-size blocks
+# ([n_layers, n_blocks, block_size, KVH, Dh]) and each slot owns an int32
+# ``block_table`` row mapping its logical positions to physical blocks.
+# Admission allocates just the blocks a request needs; retirement returns
+# them — all on the host, with device programs keeping ONE compiled
+# signature (the tables are data, not shapes, so admission never retraces).
+#
+# Block 0 is the TRASH block: it is never allocated, and unallocated table
+# entries point at it.  Free/idle rows that tick along with the batch (the
+# fixed-signature tick decodes every row) scatter their garbage K/V into
+# trash, where nothing valid ever reads it — the paged form of the slot
+# pool's write-before-read invariant.
+# ---------------------------------------------------------------------------
+
+
+class PagedKVCache(NamedTuple):
+    """Paged K/V pool: k/v ``[n_layers, n_blocks, block_size, KVH, Dh]``,
+    ``block_table`` [B, blocks_per_slot] int32 (physical block of each
+    logical block; 0 = trash), ``length`` [B] int32 filled positions."""
+
+    k: jax.Array
+    v: jax.Array
+    block_table: jax.Array
+    length: jax.Array
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def logical_len(self) -> int:
+        """Dense attention width each row's table spans (== max_len)."""
+        return self.block_table.shape[1] * self.k.shape[2]
+
+
+def init_paged_cache(
+    cfg: LlamaConfig, n_slots: int, max_len: int, *,
+    block_size: int, n_blocks: int | None = None,
+) -> PagedKVCache:
+    """A paged pool for ``n_slots`` rows of logical depth ``max_len``.
+
+    ``n_blocks`` defaults to full backing (every slot can hold max_len)
+    plus the trash block; pass less to overcommit — the paged win — and
+    let the scheduler admission-gate on free blocks."""
+    if max_len % block_size:
+        raise ValueError(
+            f"max_len {max_len} not a multiple of block_size {block_size}")
+    per = max_len // block_size
+    if n_blocks is None:
+        n_blocks = n_slots * per + 1          # +1: the trash block
+    if n_blocks < per + 1:
+        raise ValueError(
+            f"n_blocks {n_blocks} cannot back even one full slot "
+            f"({per} blocks) plus the trash block")
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        block_table=jnp.zeros((n_slots, per), jnp.int32),
+        length=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def _paged_attend(params, tokens, cfg: LlamaConfig, kv_k, kv_v,
+                  qpos, wflat, gflat):
+    """Shared body of the paged decode paths: scatter the chunk's K/V at
+    flat physical positions ``wflat`` [B, T], gather each row's dense
+    [M] view via ``gflat`` [B, M], and run :func:`decode_chunk`'s exact
+    mask/einsum math on it.  The gather width M equals the logical depth,
+    so for identical cache VALUES the masked softmax/matvec sequence is
+    the same XLA computation as the dense path — bit-identical logits
+    (gathered garbage beyond a row's frontier is masked to an exact-zero
+    softmax term, just like dense pad slots)."""
+    b, t = tokens.shape
+    nl, n_blocks, bs, kvh, dh = kv_k.shape
+    m = gflat.shape[1]
+    dt = cfg.dtype
+    x = params["embed"][tokens].astype(dt)                # [B, T, D]
+    cos, sin = rope_tables(cfg, qpos)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    valid = jnp.arange(m)[None, None, :] <= qpos[:, :, None]
+    valid = valid[:, None, None, :, :]                    # [B,1,1,T,M]
+
+    def layer(x, inputs):
+        lp, kc, vc = inputs                 # kc/vc [n_blocks, bs, KVH, Dh]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kf = kc.reshape(n_blocks * bs, kvh, dh).at[wflat].set(k)
+        vf = vc.reshape(n_blocks * bs, kvh, dh).at[wflat].set(v)
+        kd = kf[gflat]                                    # [B, M, KVH, Dh]
+        vd = vf[gflat]
+        qg = q.reshape(b, t, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        s = jnp.einsum(
+            "bqkrd,bmkd->bkrqm", qg.astype(jnp.float32),
+            kd.astype(jnp.float32)
+        ) * scale                                         # [B,KVH,R,T,M]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkrqm,bmkd->bqkrd", p, vd.astype(jnp.float32))
+        x = x + o.astype(dt).reshape(b, t, cfg.dim) @ lp["wo"].astype(dt)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (kf.reshape(n_blocks, bs, kvh, dh),
+                   vf.reshape(n_blocks, bs, kvh, dh))
+
+    x, (ks, vs) = lax.scan(layer, x, (params["layers"], kv_k, kv_v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def decode_chunk_paged(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig,
+    pcache: PagedKVCache, *, advance: jax.Array | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Paged :func:`decode_chunk`: T tokens per row against the block
+    pool; token j of row r lands in the physical block its table maps
+    position ``length_r + j`` to.
+
+    ``advance`` [B]: optional per-row length increments (0 or T) so a
+    fixed-signature serving tick can hold idle rows in place — idle rows
+    still compute (one program for the whole pool) but their writes land
+    in their table's blocks (trash for free rows) and their length stays
+    put.  ``None`` advances every row by T."""
+    b, t = tokens.shape
+    bs = pcache.block_size
+    per = pcache.block_table.shape[1]
+    pos = pcache.length                                   # [B]
+    qpos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    # writes past the table (an overflowing row) clamp into its last
+    # logical block — in-bounds garbage, never validly read
+    wblk = jnp.take_along_axis(
+        pcache.block_table, jnp.clip(qpos // bs, 0, per - 1), axis=1)
+    wflat = wblk * bs + qpos % bs                         # [B, T]
+    gflat = (pcache.block_table[:, :, None] * bs
+             + jnp.arange(bs)[None, None, :]).reshape(b, per * bs)
+    logits, ks, vs = _paged_attend(
+        params, tokens, cfg, pcache.k, pcache.v, qpos, wflat, gflat)
+    adv = (jnp.asarray(t, jnp.int32) if advance is None
+           else jnp.asarray(advance, jnp.int32))
+    return logits, pcache._replace(k=ks, v=vs, length=pos + adv)
+
+
+def decode_chunk_paged_row(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig,
+    pcache: PagedKVCache, slot: jax.Array, *, new_length: jax.Array,
+) -> tuple[jax.Array, PagedKVCache]:
+    """One row's T-token chunk against the pool: the chunked-prefill
+    admission program.  ``tokens`` [1, T] continue slot ``slot`` from its
+    current length; the row's length becomes ``new_length`` (the true
+    frontier — for a padded final prefill window that is less than
+    ``length + T``, exactly :func:`prefill_chunked`'s contract).  Only
+    this slot's blocks (and trash, for pad overflow) are touched, so
+    in-flight rows are untouched mid-prefill."""
+    b, t = tokens.shape
+    if b != 1:
+        raise ValueError(f"decode_chunk_paged_row is a B=1 program, "
+                         f"got batch {b}")
+    bs = pcache.block_size
+    per = pcache.block_table.shape[1]
+    slot = jnp.asarray(slot, jnp.int32)
+    row_table = pcache.block_table[slot]                  # [per]
+    pos = pcache.length[slot]
+    qpos = (pos + jnp.arange(t))[None, :]                 # [1, T]
+    wblk = row_table[jnp.clip(qpos // bs, 0, per - 1)]
+    wflat = wblk * bs + qpos % bs
+    gflat = (row_table[None, :, None] * bs
+             + jnp.arange(bs)[None, None, :]).reshape(1, per * bs)
+    logits, ks, vs = _paged_attend(
+        params, tokens, cfg, pcache.k, pcache.v, qpos, wflat, gflat)
+    length = pcache.length.at[slot].set(
+        jnp.asarray(new_length, jnp.int32))
+    return logits, pcache._replace(k=ks, v=vs, length=length)
+
+
 def prefill_chunked(
     params: dict, tokens: jax.Array, cfg: LlamaConfig, cache: KVCache,
     *, window: int, lengths: jax.Array | None = None,

@@ -750,11 +750,11 @@ class EagerEngine:
     # --------------------------------------------------------------- dispatch
 
     def _shard_map(self, fn, out_specs=P()):
-        from jax import shard_map
+        # check_vma/check_rep=False: outputs of these dispatch programs
+        # are replicated by construction (psum / all_gather semantics),
+        # which the varying-manual-axes inference cannot always prove.
+        from horovod_tpu.utils.compat import shard_map
 
-        # check_vma=False: outputs of these dispatch programs are replicated
-        # by construction (psum / all_gather semantics), which the varying-
-        # manual-axes inference cannot always prove.
         return jax.jit(
             shard_map(
                 fn,
@@ -1234,10 +1234,35 @@ def negotiate_gather_sizes_many(
     """Batched :func:`negotiate_gather_sizes`: K members' digests ride ONE
     engine allgather (one control-plane round-trip however many tensors a
     grouped call carries), validated member-by-member with the same
-    symmetric errors."""
+    symmetric errors.
+
+    The digest is prefixed by a member-count header that goes over its
+    OWN fixed-width exchange first: the wide digest's wire width is a
+    function of K, so ranks disagreeing on K (mismatched grouped-call
+    lists) would hit an opaque engine shape error — or deadlock — before
+    any validation could run.  The [1] header cannot mismatch in shape,
+    so a K disagreement raises the same "group member count differs"
+    error on every rank with both exchanges fully drained (no engine
+    desync for subsequent ops).  Cost: one extra tiny control round-trip
+    per grouped negotiation (skipped single-process)."""
     import zlib
 
     k = len(shapes)
+    n_header = basics.size()
+    if n_header > 1:
+        hdr = np.asarray([[k]], np.int32)
+        hg = jax.make_array_from_process_local_data(
+            basics.rank_sharding(), hdr)
+        hh = allgather_async(
+            hg, name=None if name is None else f"{name}.shapes.k")
+        ks = np.asarray(jax.device_get(synchronize(hh))).reshape(n_header)
+        for r in range(n_header):
+            if int(ks[r]) != k:
+                raise ValueError(
+                    f"allgather: group member count differs on rank {r}: "
+                    f"rank {r} negotiates {int(ks[r])} member(s) vs "
+                    f"local {k} — every rank must pass the same-length "
+                    f"tensor list to a grouped allgather")
     digest = np.zeros((k, 2 + MAX_GATHER_NDIM), np.int32)
     crcs = []
     for i, (shape, dtype_str) in enumerate(zip(shapes, dtype_strs)):

@@ -34,6 +34,7 @@ from horovod_tpu.basics import AXIS_NAME
 from horovod_tpu.ops import collective_ops
 from horovod_tpu.ops.collective_ops import Average, Sum, _ReduceOp
 from horovod_tpu.ops.compression import Compression, TopKCompressor
+from horovod_tpu.utils.compat import shard_map as _shard_map
 
 
 def allreduce_gradients(
@@ -243,7 +244,7 @@ def make_train_step(
         mean_loss = collective_ops.allreduce(loss, op=Average, axis_name=axis_name)
         return TrainStepResult(params, opt_state, mean_loss)
 
-    smapped = jax.shard_map(
+    smapped = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name)),

@@ -408,7 +408,12 @@ def _spec_programs(cfg: llama.LlamaConfig, draft_cfg: llama.LlamaConfig,
 
     @jax.jit
     def draft_round(dparams, dcache, first_tok):
-        """first_tok + draft_k-1 more draft tokens (k decode steps)."""
+        """draft_k proposals from first_tok, in draft_k + 1 decode steps:
+        the extra step consumes the LAST proposal so its K/V is in the
+        draft cache — when a round accepts all draft_k proposals the
+        frontier advances past that position, and a hole there would
+        poison every later draft.  The extra step's own token is
+        discarded (it was never verified)."""
         def step(carry, _):
             tok, cache = carry
             logits, cache = llama.decode_step(dparams, tok, draft_cfg,
@@ -417,13 +422,13 @@ def _spec_programs(cfg: llama.LlamaConfig, draft_cfg: llama.LlamaConfig,
             return (nxt, cache), nxt
 
         (_, dcache), drafts = lax.scan(
-            step, (first_tok, dcache), None, length=draft_k)
-        return jnp.moveaxis(drafts, 0, 1), dcache      # [B, draft_k]
+            step, (first_tok, dcache), None, length=draft_k + 1)
+        return jnp.moveaxis(drafts, 0, 1)[:, :draft_k], dcache
 
     @jax.jit
     def verify_round(params_, tcache, chunk):
         logits, tcache = llama.decode_chunk(params_, chunk, cfg, tcache)
-        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
         return logits, preds, tcache
 
     return draft_round, verify_round
@@ -440,11 +445,17 @@ def speculative_generate(
     draft_k: int = 4,
     max_len: int | None = None,
     prompt_lengths: jax.Array | None = None,
+    stats: dict | None = None,
+    timeline: Any = None,
 ) -> jax.Array:
     """Greedy speculative decoding: a small draft model proposes
-    ``draft_k`` tokens per round, the target verifies them all in ONE
+    ``draft_k`` tokens per round, the target verifies the full
+    ``(draft_k + 1)``-wide chunk ``[cur, d_1..d_k]`` in ONE
     :func:`~horovod_tpu.models.llama.decode_chunk` pass, and the longest
-    matching prefix is accepted plus the target's own next token.
+    matching prefix is accepted — so a round can accept all ``draft_k``
+    proposals, with position ``draft_k`` of the verify logits supplying
+    the target's own follow-on token (emitted as the next round's
+    ``cur``).  No draft decode is ever wasted.
 
     With greedy acceptance the output is **bit-identical to the target's
     own greedy** ``generate`` — the draft only changes how many target
@@ -455,7 +466,16 @@ def speculative_generate(
     each round, which makes every cache ragged — the [B] ``length``
     vector IS the rewind (stale K/V beyond it is masked and rewritten
     before any read, the same write-before-read invariant the slot pool
-    relies on).  Returns [B, max_new_tokens].
+    relies on).  Rows that hit their token budget freeze their length
+    (clamped to prompt + max_new_tokens - 1) while slower rows continue,
+    keeping every cache write in bounds by construction rather than by
+    scatter-drop semantics.  Returns [B, max_new_tokens].
+
+    ``stats``: optional dict filled with observability counters —
+    ``rounds``, ``accepted_per_round`` (list of [B] int arrays) and
+    ``max_length_seen`` (max cache length across rounds).  ``timeline``:
+    optional :class:`horovod_tpu.timeline.Timeline` receiving a
+    per-round acceptance counter event.
     """
     b, l = prompt.shape
     max_len = max_len or (l + max_new_tokens + draft_k + 1)
@@ -479,6 +499,16 @@ def speculative_generate(
     out = np.zeros((b, max_new_tokens), np.int32)
     emitted = np.zeros(b, np.int32)
     rows = np.arange(b)
+    # finished rows freeze here: the largest length any row ever needs
+    # is its last emitted token's position (prompt + max_new - 1), and
+    # clamping to it bounds every later garbage write of the frozen row
+    # to <= len_cap + draft_k < max_len — in bounds by arithmetic, not
+    # by the scatter dropping out-of-range indices
+    len_cap = np.asarray(lengths) + max_new_tokens - 1
+    if stats is not None:
+        stats["rounds"] = 0
+        stats["accepted_per_round"] = []
+        stats["max_length_seen"] = int(np.asarray(lengths).max())
 
     def emit(row, tok):
         if emitted[row] < max_new_tokens:
@@ -492,9 +522,10 @@ def speculative_generate(
             emit(r, int(cur_host[r]))
         # draft proposes cur's continuations: d_1..d_k
         drafts, dcache = draft_round(draft_params, dcache, cur)
-        # target consumes [cur, d_1..d_{k-1}] in one chunk; preds[:, i]
-        # is the target's greedy token after chunk[:, :i+1]
-        chunk = jnp.concatenate([cur[:, None], drafts[:, :-1]], axis=1)
+        # target consumes the FULL [cur, d_1..d_k] chunk; preds[:, i] is
+        # the target's greedy token after chunk[:, :i+1], so preds[:, k]
+        # (the +1 width) is the follow-on token when everything accepts
+        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)
         logits, preds, tcache = verify_round(params, tcache, chunk)
         # per-row longest accepted prefix: d_i accepted while == preds_i-1
         d_host = np.asarray(drafts)
@@ -502,16 +533,26 @@ def speculative_generate(
         accept = np.zeros(b, np.int32)
         for r in rows:
             a = 0
-            while a < draft_k - 1 and d_host[r, a] == p_host[r, a]:
+            while a < draft_k and d_host[r, a] == p_host[r, a]:
                 emit(r, int(d_host[r, a]))
                 a += 1
             accept[r] = a
-        # rewind both caches to the true accepted frontier and pick the
-        # logits that follow each row's last accepted token
-        new_len = np.asarray(lengths) + 1 + accept
+        # rewind both caches to the true accepted frontier (clamped for
+        # rows that just finished) and pick the logits that follow each
+        # row's last accepted token
+        new_len = np.minimum(np.asarray(lengths) + 1 + accept, len_cap)
         lengths = jnp.asarray(new_len, jnp.int32)
         tcache = tcache._replace(length=lengths)
         dcache = dcache._replace(length=lengths)
         tlog = logits[jnp.arange(b), jnp.asarray(accept)]      # [B, V]
+        if stats is not None:
+            stats["rounds"] += 1
+            stats["accepted_per_round"].append(accept.copy())
+            stats["max_length_seen"] = max(stats["max_length_seen"],
+                                           int(new_len.max()))
+        if timeline is not None:
+            timeline.counter(
+                "serving.speculative", "ACCEPT",
+                {"accepted": int(accept.sum()), "rows": b})
 
     return jnp.asarray(out)

@@ -128,6 +128,21 @@ class Timeline:
                  "pid": self._pid(tensor_name), "tid": 0}
             )
 
+    def counter(self, tensor_name: str, activity: str,
+                values: dict) -> None:
+        """Chrome counter event (ph 'C'): a stacked time series on the
+        track — the serving scheduler emits queue depth / slot occupancy
+        / free-block counts per step through this, and speculative
+        decoding its per-round acceptance counts.  ``values`` maps series
+        name → number."""
+        with self._lock:
+            if self._closed:
+                return
+            self._emit(
+                {"name": activity, "ph": "C", "ts": self._ts_us(),
+                 "pid": self._pid(tensor_name), "args": values}
+            )
+
     def async_start(self, tensor_name: str, activity: str, aid: int) -> None:
         """Begin an *async* span (Chrome ph 'b'): unlike B/E duration events
         these are matched by id, not the per-(pid,tid) stack, so spans that

@@ -379,6 +379,15 @@ def alltoall_async(tensor, splits=None, name=None) -> int:
     every chunk pads to the global max on the wire (the ragged-allgather
     pad-to-max strategy), one equal all-to-all moves it, and
     ``synchronize`` slices each sender's true chunk back out."""
+    if isinstance(splits, str):
+        # pre-parity signature was alltoall(tensor, name); a migrating
+        # caller's positional name would otherwise crash deep in the
+        # split parse (or worse, iterate the string as split values)
+        raise TypeError(
+            f"alltoall got a str for splits= ({splits!r}): the "
+            "reference-parity signature is alltoall(tensor, splits=None, "
+            "name=None) — name is now the third argument, pass it as "
+            "name=...")
     if splits is None:
         h = _eager.alltoall_async(_to_rank_major(tensor), name=name)
         _attach_post(h, rank_major=True)

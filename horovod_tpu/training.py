@@ -19,6 +19,7 @@ import optax
 
 from horovod_tpu import basics
 from horovod_tpu.callbacks import Callback
+from horovod_tpu.utils.compat import shard_map as _shard_map
 from horovod_tpu.optim.distributed_optimizer import make_train_step
 
 
@@ -54,7 +55,7 @@ def make_eval_step(
         }
 
     jitted = jax.jit(
-        jax.shard_map(
+        _shard_map(
             step, mesh=mesh, in_specs=(P(), P(axis_name)), out_specs=P(),
             check_vma=False,
         )

@@ -1,0 +1,28 @@
+"""Version shims for the supported jax range.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (keyword
+``check_rep``) to top-level ``jax.shard_map`` (keyword ``check_vma``)
+around jax 0.6; the library runs on both sides of that move.  Call
+:func:`shard_map` here with the NEW spelling — on an older jax the
+``check_vma`` keyword is translated to ``check_rep``.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax < 0.6: experimental home, older keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, **kwargs):
+    """`jax.shard_map` with the installed version's check keyword."""
+    if _CHECK_KW == "check_rep" and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

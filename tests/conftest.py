@@ -21,6 +21,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+if not hasattr(jax, "shard_map"):
+    # Older jax only ships jax.experimental.shard_map (keyword check_rep
+    # instead of check_vma); alias the library's shim so tests written
+    # against the new spelling run on both API generations.
+    from horovod_tpu.utils.compat import shard_map as _compat_shard_map  # noqa: E402
+
+    jax.shard_map = _compat_shard_map
+
 import pytest  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402

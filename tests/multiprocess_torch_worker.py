@@ -135,6 +135,18 @@ def main() -> None:
     assert ga[0].shape == (3, 2) and torch.allclose(
         ga[0], torch.tensor([[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]])), ga[0]
     assert torch.allclose(ga[1], torch.tensor([0.0, 1.0])), ga[1]
+    # mismatched grouped lists: ranks disagree on the member COUNT, which
+    # sets the digest wire width — the fixed-width member-count header
+    # exchange turns what would be an opaque engine shape error (or a
+    # deadlock) into the same clean diagnostic on every rank, with both
+    # exchanges drained so the ops below still run.
+    bad_group = ([torch.zeros(1), torch.zeros(1)] if me == 0
+                 else [torch.zeros(1), torch.zeros(1), torch.zeros(1)])
+    try:
+        hvd.grouped_allgather(bad_group, name="t.ga.badk")
+        raise AssertionError("mismatched group member count not detected")
+    except ValueError as e:
+        assert "group member count differs on rank" in str(e), (me, e)
     grs = hvd.grouped_reducescatter(
         [torch.arange(4, dtype=torch.float32) + me,
          torch.full((2,), 2.0 * me)], op=hvd.Sum)

@@ -116,6 +116,20 @@ def test_eager_alltoall_validates_divisibility():
         hvd.alltoall_async(bad)
 
 
+def test_torch_alltoall_str_splits_guard():
+    """A caller migrating from the pre-parity alltoall(tensor, name)
+    signature who leaves the name positional must get a clear TypeError,
+    not a deep split-parse crash (or the string silently iterated as
+    split values).  The guard fires before any engine state is touched,
+    so it's testable without torch init."""
+    from horovod_tpu import torch as hvt
+
+    with pytest.raises(TypeError, match="name is now the third argument"):
+        hvt.alltoall_async(np.zeros((8,)), "my_tensor")
+    with pytest.raises(TypeError, match="name is now the third argument"):
+        hvt.alltoall(np.zeros((8,)), splits="my_tensor")
+
+
 def test_eager_reducescatter():
     """hvd.reducescatter (Horovod >=0.21 API): ranks' tensors reduce and
     rank r keeps shard r along dim 0; Sum and Average; result rank-major."""

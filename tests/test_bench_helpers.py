@@ -202,6 +202,27 @@ def test_generate_arm_rehearsal_path(bench, monkeypatch):
     assert out["generate_shape"] == "b2_prompt8_new8"
 
 
+def test_serving_arm_rehearsal_schema(bench, monkeypatch):
+    """The serving extras arm's rehearsal config runs the real
+    ServeEngine-vs-static measurement end-to-end on the CPU stand-in and
+    reports the schema the dashboard keys on.  (The ratio itself is only
+    asserted > 1 at tuned scale in test_serving_scheduler.py — the toy
+    rehearsal is dispatch-bound on CPU.)"""
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HVD_TPU_BENCH_FORCE_TPU_PATHS", "1")
+    out = bench._bench_serving(hvd, True)
+    assert out["serve_tokens_per_sec"] > 0
+    assert isinstance(out["serve_vs_static_ratio"], float)
+    assert out["serve_shape"] == "s2_len32_chunk8_req6"
+
+
+def test_serving_arm_skipped_off_tpu(bench):
+    import horovod_tpu as hvd
+
+    assert bench._bench_serving(hvd, False) == {}
+
+
 def test_bench_fusion_autotune_arm_cpu(bench, monkeypatch):
     """The fusion A/B plus the autotuner-trajectory arm (VERDICT r3 #2's
     converged-threshold record) runs end-to-end on the CPU stand-in: both

@@ -166,6 +166,60 @@ def test_speculative_ragged_prompts(world):
     np.testing.assert_array_equal(got, want)
 
 
+def test_speculative_accepts_full_draft_width(world):
+    """With the target as its own draft every proposal is correct, so
+    every round must accept the full draft_k — the verify chunk is
+    (draft_k + 1) wide and the round's last draft token is no longer
+    thrown away (it used to cap acceptance at draft_k - 1 effectively,
+    wasting one verified token per round)."""
+    from horovod_tpu.serving import speculative_generate
+
+    cfg, params = world
+    prompt = jnp.array([[5, 17, 42], [7, 9, 3]], jnp.int32)
+    n_new, k = 9, 3
+    stats: dict = {}
+    got = np.asarray(speculative_generate(
+        params, cfg, params, cfg, prompt, max_new_tokens=n_new,
+        draft_k=k, stats=stats))
+    want = np.asarray(llama.generate(
+        params, prompt, cfg, max_new_tokens=n_new, max_len=24))
+    np.testing.assert_array_equal(got, want)
+    assert stats["rounds"] >= 1
+    for acc in stats["accepted_per_round"]:
+        np.testing.assert_array_equal(np.asarray(acc),
+                                      np.full((2,), k))
+    # full acceptance advances k+1 tokens per round
+    assert stats["rounds"] == -(-n_new // (k + 1))
+
+
+def test_speculative_finished_rows_stay_clamped(world):
+    """Regression: once a row has emitted its budget it must stop
+    advancing — with a bad draft and ragged lengths the early-finishing
+    row's length used to keep growing past prompt+max_new while the
+    other row's rounds continued, walking off the cache end."""
+    from horovod_tpu.serving import speculative_generate
+
+    cfg, params = world
+    prompt = jnp.array([[5, 17, 42, 9, 1, 6], [7, 7, 0, 0, 0, 0]],
+                       jnp.int32)
+    lengths = jnp.array([6, 2], jnp.int32)
+    n_new, k, max_len = 10, 3, 20
+    bad_draft = llama.init_params(cfg, jax.random.PRNGKey(99))
+    stats: dict = {}
+    got = np.asarray(speculative_generate(
+        params, cfg, bad_draft, cfg, prompt, max_new_tokens=n_new,
+        draft_k=k, max_len=max_len, prompt_lengths=lengths,
+        stats=stats))
+    want = np.asarray(llama.generate(
+        params, prompt, cfg, max_new_tokens=n_new, max_len=max_len,
+        prompt_lengths=lengths))
+    np.testing.assert_array_equal(got, want)
+    # the longest row finishes at lengths.max()+n_new-1; no row may
+    # ever exceed it, and every round's writes stay inside max_len
+    assert stats["max_length_seen"] <= int(lengths.max()) + n_new - 1
+    assert stats["max_length_seen"] + k < max_len
+
+
 def test_serving_randomized_stream_matches_solo(world):
     """Chaos oracle: a seeded random request stream (mixed lengths incl.
     multi-window prompts, mixed budgets, random EOS) served through a
