@@ -26,7 +26,10 @@ Beyond the reference's img/sec, the primary line carries TPU-first metrics:
 * ``extras.llama_fused_loss_*`` — the chunked fused linear+cross-entropy
   A/B; ``extras.resnet101_bs128_*`` — MFU-ceiling probe beyond the
   reference's bs-64 config; ``extras.generate_*`` — end-to-end KV-cache
-  generation throughput; ``extras.vit_b16_*`` — ViT-B/16 train step
+  generation throughput; ``extras.serve_overcommit_*`` — ServeEngine
+  throughput under an overcommitted paged-KV pool with
+  preemption-with-replay enabled (plus the preemption count);
+  ``extras.vit_b16_*`` — ViT-B/16 train step
   (dense attention at L=196; the flash crossover is ~2k tokens);
   ``extras.hbm_*`` — device memory watermark after the primary arm;
   ``extras.tunnel_rtt_ms`` — the relay's measured round-trip floor (see
@@ -498,6 +501,68 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
         "serve_vs_static_ratio": round(r["serve_vs_static_ratio"], 3),
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
                         f"req{len(reqs)}"),
+    }
+
+
+def _bench_serving_overcommit(hvd, on_tpu: bool) -> dict:
+    """Fault-tolerant serving throughput under KV pressure (extras arm,
+    TPU only): the same ServeEngine workload shape as the serving arm
+    but with the paged block pool sized BELOW full backing and
+    preemption-with-replay enabled (``preempt_after``) — the production
+    regime where admission gates on free blocks and a starved queue head
+    evicts the youngest decoding row.  Reports engine tokens/sec on the
+    overcommitted pool plus the timed pass's preemption count, so the
+    dashboard sees both the throughput cost of KV pressure and how often
+    the scheduler had to preempt to keep the head moving."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        n_slots, max_len, chunk = 2, 32, 8
+        # full backing = n_slots * ceil(max_len/chunk) + trash = 9
+        n_blocks, preempt_after = 6, 2
+        # widest static batch must still fit: global pad 9 + batch max
+        # budget 20 <= max_len 32
+        shapes = [(4, 20), (3, 20), (9, 2), (2, 10), (5, 3), (6, 8)]
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        # ~60 % of the 65-block full backing: admission must wait and
+        # long-budget rows get preempted for the starved head
+        n_blocks, preempt_after = 40, 4
+        rng = np.random.RandomState(7)
+        shapes = [(int(rng.randint(8, 192)), int(rng.choice([4, 8, 192])))
+                  for _ in range(32)]
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(11)
+    reqs = [Request(prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size, size=pl)],
+                    max_new_tokens=new)
+            for pl, new in shapes]
+    r = measure_throughput(params, cfg, reqs, n_slots=n_slots,
+                           max_len=max_len, chunk=chunk,
+                           n_blocks=n_blocks,
+                           preempt_after=preempt_after)
+    return {
+        "serve_overcommit_tokens_per_sec": round(
+            r["serve_tokens_per_sec"], 1),
+        "serve_overcommit_preemptions": int(r["preemptions"]),
+        "serve_overcommit_shape": (
+            f"s{n_slots}_len{max_len}_chunk{chunk}_blk{n_blocks}_"
+            f"pre{preempt_after}_req{len(reqs)}"),
     }
 
 
@@ -1004,6 +1069,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # 2026-08-01) — then the llama arms earlier rounds recorded, then
     # newer arms.
     for fn in (_bench_fusion, _bench_serving,
+               _bench_serving_overcommit,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):

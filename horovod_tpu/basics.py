@@ -182,6 +182,25 @@ def _post_host_card(st: "_State") -> None:
         pass
 
 
+def _negotiate_timeout_s() -> float:
+    """Host-card negotiation deadline: ``HVD_TPU_NEGOTIATE_TIMEOUT_S``
+    (seconds, default 60).  An unparsable value falls back to the
+    default rather than wedging ``init()``."""
+    raw = os.environ.get("HVD_TPU_NEGOTIATE_TIMEOUT_S", "60")
+    try:
+        return float(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"ignoring unparsable HVD_TPU_NEGOTIATE_TIMEOUT_S={raw!r}; "
+            f"using the 60 s default",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return 60.0
+
+
 def _kv_topology() -> tuple[int, int] | None:
     """Group processes by host via the cards ``_post_host_card`` published.
 
@@ -194,7 +213,10 @@ def _kv_topology() -> tuple[int, int] | None:
     One ``key_value_dir_get`` poll loop, not per-process blocking gets: a
     pod-scale gang fetches every card in O(1) round-trips per poll, and a
     peer that never posts (mixed versions) costs one shared deadline
-    before the fallback — not a 60 s stall per missing key."""
+    (``HVD_TPU_NEGOTIATE_TIMEOUT_S``, default 60) before the fallback —
+    not a full stall per missing key.  A timed-out negotiation WARNS
+    with the posted-vs-expected peer count before falling back, so a
+    wrong local topology is diagnosable instead of silent."""
     try:
         import time
 
@@ -204,12 +226,24 @@ def _kv_topology() -> tuple[int, int] | None:
         n = jax.process_count()
         if client is None or n <= 1:
             return None
-        deadline = time.monotonic() + 60.0
+        timeout_s = _negotiate_timeout_s()
+        deadline = time.monotonic() + timeout_s
         while True:
             entries = client.key_value_dir_get("horovod_tpu/hostcard/")
             if len(entries) >= n:
                 break
             if time.monotonic() >= deadline:
+                import warnings
+
+                warnings.warn(
+                    f"host-card negotiation timed out after "
+                    f"{timeout_s:g}s: {len(entries)} of {n} peers "
+                    f"posted host cards (set HVD_TPU_NEGOTIATE_TIMEOUT_S "
+                    f"to adjust); falling back to launcher-env/"
+                    f"single-host local topology",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
                 return None
             time.sleep(0.1)
         cards: dict[int, tuple[str, int]] = {}

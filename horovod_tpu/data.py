@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from horovod_tpu import basics
+from horovod_tpu import faults as faults_mod
 
 
 def shard_indices(
@@ -192,7 +193,13 @@ class ShardedLoader:
 
         def producer():
             try:
-                for batch in self._batches():
+                for i, batch in enumerate(self._batches()):
+                    # deterministic fault site (key = batch index): an
+                    # injected fault rides the existing exception
+                    # propagation below, so tests can pin that a dying
+                    # producer surfaces in the consumer instead of
+                    # wedging the queue
+                    faults_mod.check("data.producer", key=i)
                     if not put_or_abandon(batch):
                         return
                 put_or_abandon(_END)

@@ -55,7 +55,20 @@ class Request:
     ``temperature``: per-request override of the pool temperature.  A
     sampling pool serves greedy requests via 0.0; the reverse is not
     possible — a greedy pool compiles no sampling tick, so overrides > 0
-    require a sampling pool.  ``None`` inherits the pool setting."""
+    require a sampling pool.  ``None`` inherits the pool setting.
+
+    Lifecycle fields (honored by
+    :class:`~horovod_tpu.serving_scheduler.ServeEngine`; the simpler
+    :class:`ContinuousBatcher` ignores them):
+
+    ``deadline_s``: wall-clock budget from ``submit()`` — a request
+    still queued or in flight when it expires terminates with a
+    ``TIMEOUT`` result carrying its tokens-so-far.
+
+    ``max_queue_steps``: admission budget in ENGINE STEPS — a request
+    still queued after this many steps (per queue stint; a preempted
+    request's replay restarts the count) is load-shed with a
+    ``REJECTED`` result.  Step-counted so tests never sleep."""
 
     prompt: list[int]
     max_new_tokens: int
@@ -63,6 +76,49 @@ class Request:
     sample_key: Any = None
     prefix: "PrefixCache | None" = None
     temperature: float | None = None
+    deadline_s: float | None = None
+    max_queue_steps: int | None = None
+
+
+# Terminal request statuses (ServeEngine request lifecycle).
+OK = "OK"
+TIMEOUT = "TIMEOUT"
+CANCELLED = "CANCELLED"
+FAILED = "FAILED"
+REJECTED = "REJECTED"
+
+
+class RequestResult(list):
+    """Terminal result of one engine request: the emitted tokens plus a
+    lifecycle status.
+
+    Subclasses ``list`` so every pre-lifecycle consumer — parity
+    asserts, ``len()``, ``np.asarray`` — keeps working on the tokens
+    unchanged; the lifecycle layer reads ``status`` (one of ``OK /
+    TIMEOUT / CANCELLED / FAILED / REJECTED``) and, for ``FAILED``,
+    ``error`` (the exception that condemned the request).  Non-``OK``
+    results carry tokens-so-far: everything emitted before the request
+    terminated (greedy determinism makes that a prefix of the solo run).
+    """
+
+    def __init__(self, tokens=(), status: str = OK,
+                 error: BaseException | None = None):
+        super().__init__(tokens)
+        self.status = status
+        self.error = error
+
+    @property
+    def tokens(self) -> list[int]:
+        return list(self)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == OK
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        err = f", error={self.error!r}" if self.error is not None else ""
+        return (f"RequestResult(status={self.status}, "
+                f"tokens={list(self)}{err})")
 
 
 class PrefixCache:

@@ -20,6 +20,32 @@ step toward a production scheduler (Orca OSDI '22 / vLLM SOSP '23):
   the server — admission/retirement changes table *data*, never shapes,
   so XLA never re-traces (pinned by ``compile_cache_sizes`` in tests).
 
+Request lifecycle & fault tolerance (the production layer the above
+schedulers treat as first-class scheduler transitions, not crashes):
+
+* every request terminates with a typed
+  :class:`~horovod_tpu.serving.RequestResult` — status ``OK / TIMEOUT /
+  CANCELLED / FAILED / REJECTED`` plus tokens-so-far;
+* ``cancel(rid)`` works in any state (queued, prefilling, decoding);
+  per-request ``deadline_s`` (wall clock) and ``max_queue_steps``
+  (step-counted admission budget → ``REJECTED``) bound waiting;
+* **KV-pressure preemption with replay**: when the queue head has
+  starved ``preempt_after`` consecutive steps on an overcommitted block
+  pool, the youngest decoding row is preempted — blocks freed, request
+  re-queued with ``prompt + out`` as the replay prompt.  Greedy
+  determinism makes the resumed output bit-identical to the
+  uninterrupted run, and everything rides the existing ``_set_row``
+  program so no new jit signatures appear;
+* **poison-request quarantine**: a raising prefill window or decode-tick
+  readback fails only the implicated request — transient faults get
+  bounded step-counted retries with exponential backoff (decode retries
+  reuse the replay path), then a ``FAILED`` result carrying the
+  exception.  All other rows keep serving;
+* deterministic fault injection via :mod:`horovod_tpu.faults` sites
+  ``serve.admit`` / ``serve.prefill`` / ``serve.tick``, and a
+  no-progress watchdog that raises with a full scheduler-state dump
+  instead of spinning ``run()`` forever.
+
 Scheduler invariants:
 
 1. *Write-before-read*: a row's blocks hold garbage beyond its length;
@@ -28,10 +54,12 @@ Scheduler invariants:
    (one program) and scatter into the trash block (block 0).
 2. *Row independence*: attention never crosses rows, so each request's
    greedy output is bit-identical to its solo ``llama.generate`` run —
-   including requests admitted mid-flight (pinned by
-   ``tests/test_serving_scheduler.py``).
+   including requests admitted mid-flight and requests resumed after a
+   preemption (pinned by ``tests/test_serving_scheduler.py`` and
+   ``tests/test_serving_faults.py``).
 3. *Fixed signature*: host state (queue, slot states, free blocks) makes
    every decision; device programs only ever see [n_slots]-shaped data.
+   Preempt/requeue/cancel/timeout paths reuse the same three programs.
 
 The engine is greedy-only; sampling pools stay on
 :class:`~horovod_tpu.serving.ContinuousBatcher`.
@@ -41,7 +69,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from collections import deque
 from functools import partial
 from typing import Any
 
@@ -49,8 +76,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu import faults as faults_mod
 from horovod_tpu.models import llama
-from horovod_tpu.serving import Request
+from horovod_tpu.serving import (
+    CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request, RequestResult,
+)
 
 FREE, PREFILL, DECODE = "free", "prefill", "decode"
 
@@ -58,12 +88,31 @@ FREE, PREFILL, DECODE = "free", "prefill", "decode"
 @dataclasses.dataclass
 class SchedulerEvent:
     """One scheduler decision, for tests/telemetry: ``kind`` is
-    ``"admit"`` or ``"recycle"``; ``step`` the engine step index."""
+    ``"admit"``, ``"recycle"`` (OK retirement), ``"preempt"``,
+    ``"retry"``, ``"cancel"``, ``"timeout"``, ``"reject"`` or
+    ``"fail"``; ``step`` the engine step index; ``slot`` is -1 for
+    queue-side events (reject, queued cancel/timeout, admit retry)."""
 
     kind: str
     step: int
     slot: int
     request_id: int
+
+
+@dataclasses.dataclass
+class _QueueEntry:
+    """A queued request plus its lifecycle state.  ``prior`` holds
+    tokens already emitted before a preemption/replay re-queue (the
+    replay prompt is ``req.prompt + prior``); ``wait_steps`` is the
+    step-counted retry backoff; ``deadline`` is absolute monotonic."""
+
+    rid: int
+    req: Request
+    prior: list[int] = dataclasses.field(default_factory=list)
+    retries: int = 0
+    wait_steps: int = 0
+    queued_steps: int = 0
+    deadline: float | None = None
 
 
 @dataclasses.dataclass
@@ -79,6 +128,12 @@ class _Slot:
     out: list[int] = dataclasses.field(default_factory=list)
     n_blocks: int = 0                    # blocks allocated to this slot
     blocks: list[int] = dataclasses.field(default_factory=list)
+    req: Request | None = None           # original request (for replay)
+    prior: list[int] = dataclasses.field(default_factory=list)
+    retries: int = 0
+    wait_steps: int = 0                  # prefill-retry backoff
+    deadline: float | None = None
+    admit_seq: int = -1                  # monotonic; max = youngest row
 
 
 class ServeEngine:
@@ -93,17 +148,46 @@ class ServeEngine:
     fully backs every slot, smaller pools overcommit and admission waits
     for free blocks.  ``timeline``: an optional
     :class:`horovod_tpu.timeline.Timeline` receiving admit/recycle
-    instants and per-step queue/occupancy counters.
+    instants plus per-step queue/occupancy (``SCHED``) and lifecycle
+    (``LIFECYCLE``: preemptions/timeouts/retries/…) counters.
+
+    Fault-tolerance knobs:
+
+    ``preempt_after``: consecutive steps the queue head may starve on an
+    overcommitted block pool before the youngest decoding row is
+    preempted and re-queued for replay (``None`` disables preemption).
+    ``max_retries``: bounded retries for transient per-request faults
+    (prefill windows retry in place after a ``2**retries``-step backoff;
+    decode readback retries re-queue through the replay path); once
+    exhausted — or immediately on a
+    :class:`~horovod_tpu.faults.PermanentFault` — the request terminates
+    ``FAILED`` with the exception attached, and every other row keeps
+    serving.  ``watchdog_steps``: consecutive no-progress steps (no
+    admission, prefill window, decode tick, retirement, preemption, or
+    backoff countdown while work is pending) before ``step()`` raises
+    ``RuntimeError`` with a scheduler-state dump instead of letting
+    ``run()`` spin forever.  ``faults``: a
+    :class:`~horovod_tpu.faults.FaultRegistry` consulted at the
+    ``serve.admit`` / ``serve.prefill`` / ``serve.tick`` sites (defaults
+    to the shared registry, which is a no-op unless armed).
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
                  n_slots: int, max_len: int, chunk: int,
                  block_size: int | None = None,
                  n_blocks: int | None = None,
-                 timeline: Any = None):
+                 timeline: Any = None,
+                 preempt_after: int | None = None,
+                 max_retries: int = 2,
+                 watchdog_steps: int = 256,
+                 faults: "faults_mod.FaultRegistry | None" = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
                              f"{max_len}]")
+        if preempt_after is not None and preempt_after < 1:
+            raise ValueError("preempt_after must be >= 1 (or None)")
+        if watchdog_steps < 1:
+            raise ValueError("watchdog_steps must be >= 1")
         block_size = chunk if block_size is None else block_size
         self.params = params
         self.cfg = cfg
@@ -112,6 +196,10 @@ class ServeEngine:
         self.chunk = chunk
         self.block_size = block_size
         self.timeline = timeline
+        self.preempt_after = preempt_after
+        self.max_retries = max_retries
+        self.watchdog_steps = watchdog_steps
+        self.faults = faults if faults is not None else faults_mod.DEFAULT
         self.pcache = llama.init_paged_cache(
             cfg, n_slots, max_len, block_size=block_size,
             n_blocks=n_blocks)
@@ -123,10 +211,17 @@ class ServeEngine:
         self.last_logits = jnp.zeros((n_slots, cfg.vocab_size),
                                      jnp.float32)
         self._slots = [_Slot() for _ in range(n_slots)]
-        self._queue: deque[tuple[int, Request]] = deque()
+        self._queue: list[_QueueEntry] = []
         self._next_id = 0
-        self.results: dict[int, list[int]] = {}
+        self._admit_seq = 0
+        self._starve_steps = 0
+        self._idle_steps = 0
+        self._finished: dict[int, RequestResult] = {}
+        self.results: dict[int, RequestResult] = {}
         self.events: list[SchedulerEvent] = []
+        self.counters = {"preemptions": 0, "timeouts": 0,
+                         "cancellations": 0, "rejections": 0,
+                         "retries": 0, "failures": 0}
         self.step_index = 0
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -157,7 +252,9 @@ class ServeEngine:
         def _set_row(pcache, slot, row):
             # admission/retirement table write: swaps which physical
             # blocks a slot row maps to and rewinds its length — data
-            # only, so slot recycling reuses the same compiled programs
+            # only, so slot recycling (and every lifecycle transition:
+            # preempt, cancel, timeout, fail) reuses the same compiled
+            # programs
             return pcache._replace(
                 block_table=pcache.block_table.at[slot].set(row),
                 length=pcache.length.at[slot].set(0))
@@ -170,7 +267,7 @@ class ServeEngine:
 
     def compile_cache_sizes(self) -> dict[str, int]:
         """Per-program jit cache entry counts — the no-retrace pin:
-        admission/recycling must keep every count constant."""
+        admission/recycling/preemption must keep every count constant."""
         return {
             "tick": self._tick._cache_size(),
             "chunk": self._chunk._cache_size(),
@@ -184,7 +281,37 @@ class ServeEngine:
         return bool(self._queue) or any(
             s.state != FREE for s in self._slots)
 
+    def state_dump(self) -> str:
+        """Human-readable scheduler state (the watchdog's evidence)."""
+        lines = [
+            f"step={self.step_index} queue_depth={len(self._queue)} "
+            f"free_blocks={len(self._free_blocks)}/"
+            f"{self.pcache.k.shape[1] - 1} starve_steps="
+            f"{self._starve_steps} counters={self.counters}",
+        ]
+        for e in self._queue:
+            lines.append(
+                f"  queued rid={e.rid} prompt={len(e.req.prompt)} "
+                f"prior={len(e.prior)} need={self._need_blocks(e.req)} "
+                f"retries={e.retries} wait={e.wait_steps} "
+                f"queued_steps={e.queued_steps}")
+        for i, s in enumerate(self._slots):
+            lines.append(
+                f"  slot {i}: {s.state}" + (
+                    "" if s.state == FREE else
+                    f" rid={s.request_id} w={s.w_done}/{s.n_win} "
+                    f"out={len(s.out)} budget={s.budget} "
+                    f"blocks={s.n_blocks} retries={s.retries} "
+                    f"wait={s.wait_steps}"))
+        return "\n".join(lines)
+
     # -- queue -------------------------------------------------------------
+
+    def _need_blocks(self, req: Request) -> int:
+        # constant across replays: replay prompt grows by exactly the
+        # tokens the remaining budget shrinks by
+        return -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.block_size)
 
     def submit(self, req: Request) -> int:
         """Queue a request; returns its id (key into ``results``).
@@ -212,67 +339,247 @@ class ServeEngine:
             raise ValueError(
                 f"prompt {L} padded to {n_win * self.chunk} prefill "
                 f"windows exceeds max_len {self.max_len}")
-        need = -(-(L + req.max_new_tokens) // self.block_size)
-        if need > len(self._free_blocks) + sum(
-                s.n_blocks for s in self._slots):
+        need = self._need_blocks(req)
+        if need > self.pcache.k.shape[1] - 1:
             raise ValueError(
                 f"request needs {need} cache blocks but the pool only "
                 f"has {self.pcache.k.shape[1] - 1} allocatable")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, req))
+        deadline = (None if req.deadline_s is None
+                    else time.monotonic() + req.deadline_s)
+        self._queue.append(_QueueEntry(rid=rid, req=req,
+                                       deadline=deadline))
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request in ANY live state — queued, prefilling, or
+        decoding.  Its result becomes ``CANCELLED`` with tokens-so-far;
+        blocks return to the pool on the same ``_set_row`` program
+        retirement uses.  Returns False when ``rid`` is unknown or
+        already terminal (cancel-after-finish is not an error)."""
+        for i, e in enumerate(self._queue):
+            if e.rid == rid:
+                self._queue.pop(i)
+                self._finish_queued(e, CANCELLED)
+                return True
+        for slot, s in enumerate(self._slots):
+            if s.state != FREE and s.request_id == rid:
+                self._terminate(slot, CANCELLED)
+                return True
+        return False
 
     # -- scheduling --------------------------------------------------------
 
-    def _admit_ready(self) -> None:
+    def _admit_entry(self, e: _QueueEntry, slot: int) -> None:
+        prompt = list(e.req.prompt) + list(e.prior)
+        L = len(prompt)
+        need = self._need_blocks(e.req)
+        s = self._slots[slot]
+        blocks = [self._free_blocks.pop() for _ in range(need)]
+        row = self._trash_row.copy()
+        row[:need] = blocks
+        self.pcache = self._set_row(
+            self.pcache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(row))
+        n_win = -(-L // self.chunk)
+        padded = np.zeros((1, n_win * self.chunk), np.int32)
+        padded[0, :L] = prompt
+        s.state = PREFILL
+        s.request_id = e.rid
+        s.padded = padded
+        s.n_win = n_win
+        s.w_done = 0
+        s.true_len = L
+        s.budget = e.req.max_new_tokens - len(e.prior)
+        s.eos = e.req.eos_id
+        s.out = []
+        s.n_blocks = need
+        s.blocks = blocks
+        s.req = e.req
+        s.prior = list(e.prior)
+        s.retries = e.retries
+        s.wait_steps = 0
+        s.deadline = e.deadline
+        s.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        self._event("admit", slot, e.rid)
+
+    def _admit_ready(self) -> tuple[int, int | None]:
         """FIFO admission: move queued requests into free slots while
         both a slot and enough cache blocks are available.  Head-of-line
-        blocking is deliberate — FIFO keeps per-request latency fair."""
-        while self._queue:
-            free = [i for i, s in enumerate(self._slots)
+        blocking on BLOCK pressure is deliberate — FIFO keeps
+        per-request latency fair (and feeds the preemption trigger);
+        entries serving a retry backoff are skipped past.  Returns
+        ``(admitted, starved_need)`` — the block count the stalled head
+        needs, or None when nothing block-starved."""
+        admitted = 0
+        i = 0
+        while i < len(self._queue):
+            free = [j for j, s in enumerate(self._slots)
                     if s.state == FREE]
             if not free:
-                return
-            rid, req = self._queue[0]
-            L = len(req.prompt)
-            need = -(-(L + req.max_new_tokens) // self.block_size)
+                return admitted, None
+            e = self._queue[i]
+            if e.wait_steps > 0:          # admit-retry backoff
+                i += 1
+                continue
+            need = self._need_blocks(e.req)
             if need > len(self._free_blocks):
-                return                       # blocks free on retirement
-            self._queue.popleft()
-            slot = free[0]
-            s = self._slots[slot]
-            blocks = [self._free_blocks.pop() for _ in range(need)]
-            row = self._trash_row.copy()
-            row[:need] = blocks
-            self.pcache = self._set_row(
-                self.pcache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(row))
-            n_win = -(-L // self.chunk)
-            padded = np.zeros((1, n_win * self.chunk), np.int32)
-            padded[0, :L] = req.prompt
-            s.state = PREFILL
-            s.request_id = rid
-            s.padded = padded
-            s.n_win = n_win
-            s.w_done = 0
-            s.true_len = L
-            s.budget = req.max_new_tokens
-            s.eos = req.eos_id
-            s.out = []
-            s.n_blocks = need
-            s.blocks = blocks
-            self._event("admit", slot, rid)
+                return admitted, need     # blocks free on retirement
+            try:
+                self.faults.check("serve.admit", key=e.rid)
+            except Exception as exc:
+                if (isinstance(exc, faults_mod.PermanentFault)
+                        or e.retries >= self.max_retries):
+                    self._queue.pop(i)
+                    self._finish_queued(e, FAILED, exc)
+                else:
+                    e.retries += 1
+                    e.wait_steps = 2 ** e.retries
+                    self.counters["retries"] += 1
+                    self._event("retry", -1, e.rid)
+                    i += 1
+                continue
+            self._queue.pop(i)
+            self._admit_entry(e, free[0])
+            admitted += 1
+        return admitted, None
 
-    def _retire(self, slot: int) -> None:
+    def _replay_len(self, s: _Slot) -> int:
+        return len(s.req.prompt) + len(s.prior) + len(s.out)
+
+    def _replayable(self, s: _Slot) -> bool:
+        # the replay prompt must still fit the chunked-prefill padding
+        n_win = -(-self._replay_len(s) // self.chunk)
+        return n_win * self.chunk <= self.max_len
+
+    def _requeue(self, slot: int, *, retried: bool) -> None:
+        """Free a row and put its request back in the queue with
+        ``prompt + out`` as the replay prompt (preemption, or a decode
+        retry — which replays rather than re-ticking because the faulted
+        tick already advanced the row's cache position)."""
         s = self._slots[slot]
-        self.results[s.request_id] = s.out
+        entry = _QueueEntry(
+            rid=s.request_id, req=s.req,
+            prior=list(s.prior) + list(s.out),
+            retries=s.retries + (1 if retried else 0),
+            wait_steps=2 ** (s.retries + 1) if retried else 0,
+            deadline=s.deadline)
         self._free_blocks.extend(reversed(s.blocks))
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(self._trash_row))
-        self._event("recycle", slot, s.request_id)
         self._slots[slot] = _Slot()
+        self._queue.append(entry)
+
+    def _preempt(self, need: int) -> int:
+        """Preempt youngest decoding rows until the starved head's
+        ``need`` blocks are free (or no candidate remains).  Preempted
+        requests re-queue for replay; greedy determinism makes their
+        resumed output bit-identical to the uninterrupted run."""
+        preempted = 0
+        while len(self._free_blocks) < need:
+            cands = [(s.admit_seq, i) for i, s in enumerate(self._slots)
+                     if s.state == DECODE and self._replayable(s)]
+            if not cands:
+                break
+            slot = max(cands)[1]
+            self._event("preempt", slot, self._slots[slot].request_id)
+            self.counters["preemptions"] += 1
+            self._requeue(slot, retried=False)
+            preempted += 1
+        return preempted
+
+    def _terminate(self, slot: int, status: str,
+                   error: BaseException | None = None) -> RequestResult:
+        """Retire a row with a terminal status: blocks back to the pool,
+        row to the trash block (the same fixed-signature table write for
+        every status — OK, TIMEOUT, CANCELLED, FAILED)."""
+        s = self._slots[slot]
+        res = RequestResult(list(s.prior) + list(s.out), status, error)
+        self.results[s.request_id] = res
+        self._finished[s.request_id] = res
+        self._free_blocks.extend(reversed(s.blocks))
+        self.pcache = self._set_row(
+            self.pcache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(self._trash_row))
+        kind = {OK: "recycle", TIMEOUT: "timeout",
+                CANCELLED: "cancel", FAILED: "fail"}[status]
+        self._event(kind, slot, s.request_id)
+        self._bump_status(status)
+        self._slots[slot] = _Slot()
+        return res
+
+    def _finish_queued(self, e: _QueueEntry, status: str,
+                       error: BaseException | None = None) -> None:
+        """Terminal result for a request that never (re)entered a slot:
+        tokens-so-far is whatever a previous stint emitted."""
+        res = RequestResult(list(e.prior), status, error)
+        self.results[e.rid] = res
+        self._finished[e.rid] = res
+        kind = {TIMEOUT: "timeout", CANCELLED: "cancel",
+                REJECTED: "reject", FAILED: "fail"}[status]
+        self._event(kind, -1, e.rid)
+        self._bump_status(status)
+
+    def _bump_status(self, status: str) -> None:
+        key = {TIMEOUT: "timeouts", CANCELLED: "cancellations",
+               REJECTED: "rejections", FAILED: "failures"}.get(status)
+        if key is not None:
+            self.counters[key] += 1
+
+    def _slot_fault(self, slot: int, exc: BaseException) -> None:
+        """Quarantine a prefill-window fault to its own request:
+        transient → bounded in-place retry after a ``2**retries``-step
+        backoff (the window never ran, so state is intact); permanent or
+        retries exhausted → ``FAILED``, everything else keeps serving."""
+        s = self._slots[slot]
+        if (isinstance(exc, faults_mod.PermanentFault)
+                or s.retries >= self.max_retries):
+            self._terminate(slot, FAILED, exc)
+            return
+        s.retries += 1
+        s.wait_steps = 2 ** s.retries
+        self.counters["retries"] += 1
+        self._event("retry", slot, s.request_id)
+
+    def _row_fault(self, slot: int, exc: BaseException) -> None:
+        """Quarantine a decode-tick readback fault: the faulted tick
+        already advanced the row's cache, so a transient retry goes
+        through the replay path (free blocks, re-queue with prompt+out —
+        greedy determinism reproduces the discarded token exactly);
+        permanent or exhausted → ``FAILED``."""
+        s = self._slots[slot]
+        if (isinstance(exc, faults_mod.PermanentFault)
+                or s.retries >= self.max_retries
+                or not self._replayable(s)):
+            self._terminate(slot, FAILED, exc)
+            return
+        self.counters["retries"] += 1
+        self._event("retry", slot, s.request_id)
+        self._requeue(slot, retried=True)
+
+    def _expire(self, now: float | None) -> int:
+        """Deadline (wall-clock) and queue-budget (step-counted)
+        enforcement; returns how many requests terminated."""
+        done = 0
+        if now is not None:
+            i = 0
+            while i < len(self._queue):
+                e = self._queue[i]
+                if e.deadline is not None and now >= e.deadline:
+                    self._queue.pop(i)
+                    self._finish_queued(e, TIMEOUT)
+                    done += 1
+                    continue
+                i += 1
+            for slot, s in enumerate(self._slots):
+                if (s.state != FREE and s.deadline is not None
+                        and now >= s.deadline):
+                    self._terminate(slot, TIMEOUT)
+                    done += 1
+        return done
 
     def _event(self, kind: str, slot: int, rid: int) -> None:
         self.events.append(
@@ -280,45 +587,110 @@ class ServeEngine:
         if self.timeline is not None:
             self.timeline.instant("serving.scheduler", kind.upper())
 
-    def step(self) -> dict[int, list[int]]:
-        """One engine step: admit, run one prefill window per admitting
+    def step(self) -> dict[int, RequestResult]:
+        """One engine step: expire deadlines, admit (preempting for a
+        starved head if enabled), run one prefill window per admitting
         slot, then one decode tick over the pool.  Returns
-        ``{request_id: tokens}`` for requests that finished."""
-        self._admit_ready()
+        ``{request_id: RequestResult}`` for every request that reached a
+        terminal state during the step."""
+        self._finished = {}
+        progress = 0
+        # deadlines first: an expired request must not admit or tick
+        now = None
+        if (any(e.deadline is not None for e in self._queue)
+                or any(s.deadline is not None for s in self._slots
+                       if s.state != FREE)):
+            now = time.monotonic()
+        progress += self._expire(now)
+        # queue bookkeeping: backoff countdown + admission budgets
+        i = 0
+        while i < len(self._queue):
+            e = self._queue[i]
+            if (e.req.max_queue_steps is not None
+                    and e.queued_steps >= e.req.max_queue_steps):
+                self._queue.pop(i)
+                self._finish_queued(e, REJECTED)
+                progress += 1
+                continue
+            e.queued_steps += 1
+            if e.wait_steps > 0:
+                e.wait_steps -= 1
+                progress += 1
+            i += 1
+        admitted, starved_need = self._admit_ready()
+        progress += admitted
+        if starved_need is None:
+            self._starve_steps = 0
+        else:
+            self._starve_steps += 1
+            if (self.preempt_after is not None
+                    and self._starve_steps >= self.preempt_after):
+                freed = self._preempt(starved_need)
+                if freed:
+                    progress += freed
+                    self._starve_steps = 0
+                    more, _ = self._admit_ready()  # head admits this step
+                    progress += more
         for slot, s in enumerate(self._slots):
             if s.state != PREFILL:
+                continue
+            if s.wait_steps > 0:          # prefill-retry backoff
+                s.wait_steps -= 1
+                progress += 1
                 continue
             w = s.w_done
             final = w == s.n_win - 1
             toks = s.padded[:, w * self.chunk:(w + 1) * self.chunk]
             new_len = s.true_len if final else (w + 1) * self.chunk
             sel = s.true_len - 1 - w * self.chunk if final else 0
-            self.pcache, self.last_logits = self._chunk(
-                self.params, self.pcache, self.last_logits,
-                jnp.asarray(toks), jnp.asarray(slot, jnp.int32),
-                jnp.asarray(new_len, jnp.int32),
-                jnp.asarray(sel, jnp.int32))
+            try:
+                self.faults.check("serve.prefill", key=s.request_id)
+                self.pcache, self.last_logits = self._chunk(
+                    self.params, self.pcache, self.last_logits,
+                    jnp.asarray(toks), jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(new_len, jnp.int32),
+                    jnp.asarray(sel, jnp.int32))
+            except Exception as exc:
+                self._slot_fault(slot, exc)
+                progress += 1
+                continue
             s.w_done += 1
+            progress += 1
             if final:
                 s.state = DECODE      # joins this step's tick
-        finished: dict[int, list[int]] = {}
         decoding = [i for i, s in enumerate(self._slots)
                     if s.state == DECODE]
         if decoding:
-            active = np.zeros((self.n_slots,), np.int32)
-            active[decoding] = 1
-            tok, self.last_logits, self.pcache = self._tick(
-                self.params, self.pcache, self.last_logits,
-                jnp.asarray(active))
-            tok_host = np.asarray(tok)
-            for slot in decoding:
-                s = self._slots[slot]
-                t = int(tok_host[slot])
-                s.out.append(t)
-                s.budget -= 1
-                if s.budget <= 0 or t == s.eos:
-                    finished[s.request_id] = s.out
-                    self._retire(slot)
+            try:
+                active = np.zeros((self.n_slots,), np.int32)
+                active[decoding] = 1
+                tok, self.last_logits, self.pcache = self._tick(
+                    self.params, self.pcache, self.last_logits,
+                    jnp.asarray(active))
+                tok_host = np.asarray(tok)
+            except Exception as exc:
+                # a whole-tick failure cannot be attributed to one row;
+                # quarantine every decoding row (transients replay)
+                for slot in decoding:
+                    self._row_fault(slot, exc)
+                progress += len(decoding)
+            else:
+                progress += len(decoding)
+                for slot in decoding:
+                    s = self._slots[slot]
+                    t = int(tok_host[slot])
+                    try:
+                        self.faults.check("serve.tick", key=s.request_id)
+                        if not 0 <= t < self.cfg.vocab_size:
+                            raise faults_mod.PermanentFault(
+                                "serve.tick", s.request_id, -1)
+                    except Exception as exc:
+                        self._row_fault(slot, exc)
+                        continue
+                    s.out.append(t)
+                    s.budget -= 1
+                    if s.budget <= 0 or t == s.eos:
+                        self._terminate(slot, OK)
         if self.timeline is not None:
             self.timeline.counter(
                 "serving.scheduler", "SCHED",
@@ -327,12 +699,26 @@ class ServeEngine:
                  "prefilling": sum(1 for s in self._slots
                                    if s.state == PREFILL),
                  "free_blocks": len(self._free_blocks)})
+            self.timeline.counter(
+                "serving.scheduler", "LIFECYCLE", dict(self.counters))
+        if self.pending() and progress == 0:
+            self._idle_steps += 1
+            if self._idle_steps >= self.watchdog_steps:
+                raise RuntimeError(
+                    f"ServeEngine made no scheduling progress for "
+                    f"{self._idle_steps} consecutive steps (no admit / "
+                    f"prefill window / decode tick / retirement / "
+                    f"preemption while work is pending) — the scheduler "
+                    f"is stuck.  State:\n{self.state_dump()}")
+        else:
+            self._idle_steps = 0
         self.step_index += 1
-        return finished
+        return self._finished
 
-    def run(self, requests: list[Request]) -> list[list[int]]:
+    def run(self, requests: list[Request]) -> list[RequestResult]:
         """Serve ``requests`` to completion; returns each request's
-        tokens in submission order."""
+        :class:`~horovod_tpu.serving.RequestResult` in submission order
+        (each is a list of the emitted tokens, carrying ``.status``)."""
         ids = [self.submit(r) for r in requests]
         while self.pending():
             self.step()
@@ -348,6 +734,7 @@ def measure_throughput(
     params: dict, cfg: llama.LlamaConfig, requests: list[Request], *,
     n_slots: int, max_len: int, chunk: int,
     block_size: int | None = None, n_blocks: int | None = None,
+    preempt_after: int | None = None,
 ) -> dict:
     """Continuous-batching vs fixed-batch throughput on one workload.
 
@@ -358,19 +745,23 @@ def measure_throughput(
     batching exists to remove).  Both paths are warmed (compiled) before
     timing; only true emitted tokens count, for both.  Returns
     ``serve_tokens_per_sec``, ``static_tokens_per_sec``,
-    ``serve_vs_static_ratio`` and workload shape fields.
+    ``serve_vs_static_ratio``, ``preemptions`` (timed pass only; nonzero
+    only with ``preempt_after`` on an overcommitted ``n_blocks`` pool)
+    and workload shape fields.
     """
     if not requests:
         raise ValueError("empty workload")
 
     eng = ServeEngine(params, cfg, n_slots=n_slots, max_len=max_len,
                       chunk=chunk, block_size=block_size,
-                      n_blocks=n_blocks)
+                      n_blocks=n_blocks, preempt_after=preempt_after)
     warm = eng.run(requests)                 # compiles every program
+    assert all(r.ok for r in warm), [r.status for r in warm]
     n_tokens = sum(len(t) for t in warm)
     # timed pass reuses the SAME engine (its jit programs are
     # per-instance): after run() every slot is free, so the pool is in
     # its admission-ready state again
+    preempt0 = eng.counters["preemptions"]
     t0 = time.perf_counter()
     out = eng.run(requests)
     jax.block_until_ready(eng.pcache.k)
@@ -411,6 +802,7 @@ def measure_throughput(
         "serve_tokens_per_sec": n_tokens / t_serve,
         "static_tokens_per_sec": n_tokens / t_static,
         "serve_vs_static_ratio": t_static / t_serve,
+        "preemptions": eng.counters["preemptions"] - preempt0,
         "tokens": n_tokens,
         "n_requests": len(requests),
         "n_slots": n_slots,

@@ -132,9 +132,11 @@ class Timeline:
                 values: dict) -> None:
         """Chrome counter event (ph 'C'): a stacked time series on the
         track — the serving scheduler emits queue depth / slot occupancy
-        / free-block counts per step through this, and speculative
-        decoding its per-round acceptance counts.  ``values`` maps series
-        name → number."""
+        / free-block counts (``SCHED``) and cumulative lifecycle totals
+        (``LIFECYCLE``: preemptions / timeouts / cancellations /
+        rejections / retries / failures) per step through this, and
+        speculative decoding its per-round acceptance counts.
+        ``values`` maps series name → number."""
         with self._lock:
             if self._closed:
                 return

@@ -11,7 +11,16 @@ Must run before any jax backend initialization; the axon TPU plugin forces
 ``jax_platforms`` at interpreter start, so we override it back to cpu here.
 """
 
+import faulthandler
 import os
+
+# Belt and braces with pytest's faulthandler plugin (whose
+# faulthandler_timeout ini, set in pyproject.toml, prints all stacks
+# when a test wedges): enable the handler even under `-p no:...` runs
+# so a hard fault or external SIGABRT always dumps stacks instead of
+# dying mute.
+if not faulthandler.is_enabled():
+    faulthandler.enable()
 
 _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):

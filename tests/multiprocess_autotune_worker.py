@@ -11,9 +11,19 @@ Launched by tests/test_multiprocess.py with HOROVOD_AUTOTUNE=1, the native
 controller on, and fast tuner knobs.
 """
 
+import faulthandler
 import json
 import os
 import sys
+
+# A deadlocked gang must print stacks, not die mute: dump every
+# thread's traceback if this worker is still wedged after the dump
+# deadline (the dump itself does not kill the process; the launcher's
+# join timeout still decides pass/fail).
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
 
 
 def main() -> None:

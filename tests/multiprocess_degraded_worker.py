@@ -5,9 +5,19 @@ caller-delimited fusion groups fuse — and those must still produce correct,
 deadlock-free results because the group boundaries are identical on every
 process (eager.py's cross-host safety claim for the degraded mode)."""
 
+import faulthandler
 import json
 import os
 import sys
+
+# A deadlocked gang must print stacks, not die mute: dump every
+# thread's traceback if this worker is still wedged after the dump
+# deadline (the dump itself does not kill the process; the launcher's
+# join timeout still decides pass/fail).
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
 
 
 def main() -> None:

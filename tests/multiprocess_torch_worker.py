@@ -6,9 +6,19 @@ broadcast_optimizer_state round-trip, and hook-based DistributedOptimizer
 training that keeps ranks bit-identical.
 """
 
+import faulthandler
 import json
 import os
 import sys
+
+# A deadlocked gang must print stacks, not die mute: dump every
+# thread's traceback if this worker is still wedged after the dump
+# deadline (the dump itself does not kill the process; the launcher's
+# join timeout still decides pass/fail).
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
 
 
 def main() -> None:

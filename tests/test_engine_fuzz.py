@@ -152,6 +152,102 @@ def test_engine_pipelined_dispatch_native_controller(monkeypatch):
         hvd.init()
 
 
+@pytest.mark.faults
+@pytest.mark.parametrize("seed", [3, 17])
+def test_serve_engine_fault_schedule_fuzz(seed):
+    """Randomized request lifecycle sweep of the ServeEngine under an
+    overcommitted KV pool: seeded random prompts/budgets, one hard
+    deadline, one permanently poisoned request, transient injected
+    faults at the admit/prefill sites, mid-flight cancels, and a queue
+    budget — all step-counted, no sleeps.  The directed tests in
+    test_serving_faults.py pin each path; this sweep interleaves them
+    and checks the two global invariants: every result's tokens are a
+    prefix of (and for OK, equal to) its solo ``llama.generate`` run,
+    and the non-OK statuses land exactly where the schedule says."""
+    import jax
+
+    from horovod_tpu.faults import FaultRegistry
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import (
+        CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request,
+    )
+    from horovod_tpu.serving_scheduler import ServeEngine
+
+    rng = np.random.default_rng(seed)
+    cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    max_len = 24
+
+    reqs = []
+    for _ in range(8):
+        pl = int(rng.integers(2, 10))
+        new = int(rng.integers(1, min(10, max_len - pl) + 1))
+        reqs.append(Request(
+            prompt=[int(t) for t in rng.integers(1, cfg.vocab_size, pl)],
+            max_new_tokens=new))
+
+    # Assign one lifecycle role per request, at a shuffled position so
+    # the roles land on different submit orders per seed.
+    roles = rng.permutation(8)
+    dl, perm, tr_admit, tr_prefill, c0, c1, shed = (int(i) for i in roles[:7])
+    reqs[dl].deadline_s = 0.0              # expired on arrival
+    reqs[shed].max_queue_steps = 2         # load-shed under pressure
+
+    # Overcommitted pool: full backing would be 2*6+1 = 13 blocks; 9
+    # forces admission stalls and preemption-with-replay churn.
+    reg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=max_len, chunk=4,
+                      block_size=4, n_blocks=9, preempt_after=2,
+                      faults=reg)
+    ids = [eng.submit(r) for r in reqs]
+    reg.inject("serve.tick", on_hit=2, permanent=True, key=ids[perm])
+    reg.inject("serve.admit", on_hit=1, key=ids[tr_admit])
+    reg.inject("serve.prefill", on_hit=1, key=ids[tr_prefill])
+    cancel_at = {ids[c0]: int(rng.integers(1, 4)),
+                 ids[c1]: int(rng.integers(4, 9))}
+
+    step = 0
+    while eng.pending() and step < 400:
+        for rid, at in cancel_at.items():
+            if at == step:
+                eng.cancel(rid)
+        eng.step()
+        step += 1
+    assert not eng.pending(), f"fuzz seed={seed} did not drain"
+
+    allowed = {ids[i]: {OK} for i in range(8)}
+    allowed[ids[dl]] = {TIMEOUT}
+    allowed[ids[perm]] = {FAILED}
+    allowed[ids[shed]] = {OK, REJECTED}
+    allowed[ids[c0]] = {OK, CANCELLED}
+    allowed[ids[c1]] = {OK, CANCELLED}
+    statuses = []
+    for i, req in enumerate(reqs):
+        res = eng.results[ids[i]]
+        statuses.append(res.status)
+        assert res.status in allowed[ids[i]], (
+            f"seed={seed} rid={ids[i]} role-violating status {res.status}")
+        want = np.asarray(llama.generate(
+            params, jnp.asarray([req.prompt], jnp.int32), cfg,
+            max_new_tokens=req.max_new_tokens, max_len=max_len))[0]
+        got = np.asarray(list(res), np.int64)
+        if res.status == OK:
+            np.testing.assert_array_equal(
+                got, want.astype(np.int64),
+                err_msg=f"seed={seed} rid={ids[i]} OK not solo-identical")
+        else:
+            assert len(got) <= len(want)
+            np.testing.assert_array_equal(
+                got, want[:len(got)].astype(np.int64),
+                err_msg=f"seed={seed} rid={ids[i]} partial diverged")
+    assert statuses[dl] == TIMEOUT and statuses[perm] == FAILED
+    # Lifecycle churn must not leak device state: the three compiled
+    # programs and the whole block pool survive the sweep intact.
+    assert eng.compile_cache_sizes() == {"tick": 1, "chunk": 1,
+                                         "set_row": 1}
+    assert len(eng._free_blocks) == eng.pcache.k.shape[1] - 1
+
+
 def test_engine_random_interleaving_native_controller(monkeypatch):
     """The chaos sweep through the native C++ controller (gather→match→
     fuse→bcast in controller.cc) instead of the in-process Python

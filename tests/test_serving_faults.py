@@ -1,0 +1,390 @@
+"""Request lifecycle & fault tolerance of the ServeEngine.
+
+Every test is step-counted — fault schedules, backoffs, queue budgets,
+and preemption triggers are all functions of the engine step index, so
+there is NOT ONE sleep in this file and every run is bit-reproducible.
+The two hard engine invariants stay pinned through every lifecycle
+transition:
+
+1. *Bit-parity*: every request that terminates ``OK`` — including one
+   preempted mid-decode and resumed via replay, or one that survived a
+   transient injected fault — emits exactly the tokens its solo
+   ``llama.generate`` run emits; every non-``OK`` result's tokens-so-far
+   are a prefix of that solo run.
+2. *Fixed signature*: preempt / requeue / cancel / timeout / fail all
+   ride the existing three compiled programs —
+   ``compile_cache_sizes()`` never moves.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import faults as faults_mod
+from horovod_tpu.faults import (
+    FaultRegistry, PermanentFault, TransientFault,
+)
+from horovod_tpu.models import llama
+from horovod_tpu.serving import (
+    CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request, RequestResult,
+)
+from horovod_tpu.serving_scheduler import ServeEngine
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    return cfg, params
+
+
+def _solo(params, cfg, prompt, n_new, max_len):
+    return np.asarray(llama.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n_new, max_len=max_len,
+    ))[0]
+
+
+def _assert_solo_prefix(params, cfg, req, res, max_len):
+    """OK results equal the solo run; partial results are a prefix of
+    it (greedy determinism — tokens-so-far never diverge)."""
+    want = _solo(params, cfg, req.prompt, req.max_new_tokens, max_len)
+    got = np.asarray(list(res), np.int64)
+    if res.status == OK:
+        np.testing.assert_array_equal(got, want.astype(np.int64))
+    else:
+        assert len(got) <= len(want)
+        np.testing.assert_array_equal(got, want[:len(got)].astype(np.int64))
+
+
+# -- the registry itself -----------------------------------------------------
+
+
+def test_fault_registry_schedules():
+    reg = FaultRegistry()
+    rule = reg.inject("serve.tick", on_hit=3, count=2)
+    perm = reg.inject("serve.tick", on_hit=7, permanent=True, key=42)
+    for _ in range(2):
+        reg.check("serve.tick", key=1)       # hits 1, 2: quiet
+    with pytest.raises(TransientFault):
+        reg.check("serve.tick", key=1)       # hit 3 fires
+    with pytest.raises(TransientFault):
+        reg.check("serve.tick", key=1)       # hit 4 fires (count=2)
+    reg.check("serve.tick", key=1)           # hit 5: transient cleared
+    assert rule.fired == 2 and rule.seen == 5
+    # the keyed permanent rule counts only key=42 hits
+    assert perm.seen == 0
+    for _ in range(6):
+        reg.check("serve.tick", key=42)
+    for _ in range(3):                       # fires on EVERY hit >= 7
+        with pytest.raises(PermanentFault):
+            reg.check("serve.tick", key=42)
+    assert perm.fired == 3
+    assert reg.hits("serve.tick") == 14
+    assert len(reg.log) == 5
+    reg.clear()
+    assert reg.hits("serve.tick") == 0 and not reg.rules
+    with pytest.raises(ValueError):
+        reg.inject("x", on_hit=0)
+
+
+# -- deadlines, queue budgets, cancellation ----------------------------------
+
+
+def test_deadline_times_out_queued_request(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4)
+    occupant = eng.submit(Request(prompt=[5, 17, 42], max_new_tokens=8))
+    doomed = eng.submit(Request(prompt=[7, 8], max_new_tokens=4,
+                                deadline_s=0.0))
+    finished = eng.step()
+    assert finished[doomed].status == TIMEOUT
+    assert list(finished[doomed]) == []
+    assert eng.counters["timeouts"] == 1
+    while eng.pending():
+        eng.step()
+    assert eng.results[occupant].status == OK
+    _assert_solo_prefix(params, cfg, Request(prompt=[5, 17, 42],
+                                             max_new_tokens=8),
+                        eng.results[occupant], 16)
+
+
+def test_deadline_times_out_inflight_request(world):
+    cfg, params = world
+    req = Request(prompt=[5, 17, 42], max_new_tokens=10, deadline_s=60.0)
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4)
+    rid = eng.submit(req)
+    for _ in range(4):
+        eng.step()                           # decoding, tokens emitted
+    assert eng._slots[0].state == "decode"
+    # expire the deadline without sleeping (white-box: the absolute
+    # monotonic deadline lives on the slot once admitted)
+    eng._slots[0].deadline = time.monotonic() - 1.0
+    finished = eng.step()
+    res = finished[rid]
+    assert res.status == TIMEOUT and len(res) > 0
+    _assert_solo_prefix(params, cfg, req, res, 16)
+    assert not eng.pending()
+    assert eng.free_block_count() == eng.pcache.k.shape[1] - 1
+
+
+def test_max_queue_steps_rejects(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4)
+    occ_req = Request(prompt=[5, 17, 42], max_new_tokens=8)
+    occupant = eng.submit(occ_req)
+    shed = eng.submit(Request(prompt=[9, 9], max_new_tokens=4,
+                              max_queue_steps=2))
+    statuses = {}
+    while eng.pending():
+        statuses.update(eng.step())
+    assert statuses[shed].status == REJECTED
+    assert list(statuses[shed]) == []
+    assert eng.counters["rejections"] == 1
+    assert statuses[occupant].status == OK
+    _assert_solo_prefix(params, cfg, occ_req, eng.results[occupant], 16)
+    # rejected after exactly its budget of queued steps (0 and 1): the
+    # reject fires at the top of step 2
+    reject = [e for e in eng.events if e.kind == "reject"][0]
+    assert reject.step == 2 and reject.slot == -1
+
+
+def test_cancel_in_every_state(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=32, chunk=4)
+    run_req = Request(prompt=[5, 17, 42], max_new_tokens=6)
+    running = eng.submit(run_req)
+    queued = eng.submit(Request(prompt=[7], max_new_tokens=3))
+    # 1) queued: cancelled before ever touching a slot
+    assert eng.cancel(queued)
+    assert eng.results[queued].status == CANCELLED
+    assert list(eng.results[queued]) == []
+    assert not eng.cancel(queued)            # already terminal
+    assert not eng.cancel(999)               # unknown rid
+    # 2) decoding: tokens-so-far survive the cancel
+    for _ in range(3):
+        eng.step()
+    assert eng.cancel(running)
+    res = eng.results[running]
+    assert res.status == CANCELLED and len(res) > 0
+    _assert_solo_prefix(params, cfg, run_req, res, 32)
+    # 3) mid-prefill: a multi-window prompt cancelled between windows
+    long_req = Request(prompt=list(range(1, 15)), max_new_tokens=4)
+    mid = eng.submit(long_req)
+    eng.step()                               # window 1 of 4 ran
+    assert eng._slots[0].state == "prefill"
+    assert eng.cancel(mid)
+    assert eng.results[mid].status == CANCELLED
+    assert list(eng.results[mid]) == []
+    assert eng.counters["cancellations"] == 3
+    # every block came home and the engine still serves
+    assert eng.free_block_count() == eng.pcache.k.shape[1] - 1
+    after = eng.run([Request(prompt=[3, 1], max_new_tokens=4)])[0]
+    assert after.status == OK
+    _assert_solo_prefix(params, cfg, Request(prompt=[3, 1],
+                                             max_new_tokens=4), after, 32)
+
+
+# -- preemption with replay --------------------------------------------------
+
+
+def test_preemption_replay_bit_parity(world):
+    """The acceptance pin: a row preempted mid-decode for a starved
+    queue head resumes via replay and emits tokens bit-identical to its
+    uninterrupted run — with zero new jit signatures."""
+    cfg, params = world
+    # 5 allocatable blocks: victim needs 4, head needs 3 → head starves
+    # until the victim (the only decoding row) is preempted for it.
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      block_size=4, n_blocks=6, preempt_after=2)
+    victim = Request(prompt=[5, 17, 42], max_new_tokens=13)   # 4 blocks
+    head = Request(prompt=[7, 8], max_new_tokens=6)           # 3 blocks
+    out = eng.run([victim, head])
+    assert eng.counters["preemptions"] >= 1
+    kinds = [e.kind for e in eng.events]
+    assert "preempt" in kinds
+    # the victim was admitted at least twice: original + replay
+    admits = [e for e in eng.events if e.kind == "admit"
+              and e.request_id == 0]
+    assert len(admits) >= 2
+    for req, res in zip([victim, head], out):
+        assert res.status == OK
+        _assert_solo_prefix(params, cfg, req, res, 16)
+    assert eng.compile_cache_sizes() == {
+        "tick": 1, "chunk": 1, "set_row": 1}
+    assert eng.free_block_count() == 5
+
+
+def test_preemption_under_churn_parity(world):
+    """Many requests through an overcommitted pool with an aggressive
+    preemption trigger: ping-ponging preemptions still terminate and
+    every result stays solo-exact."""
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      block_size=4, n_blocks=6, preempt_after=1)
+    reqs = [
+        Request(prompt=[5, 17, 42], max_new_tokens=12),
+        Request(prompt=[7], max_new_tokens=10),
+        Request(prompt=[9, 1, 2, 3], max_new_tokens=8),
+        Request(prompt=[100, 101], max_new_tokens=11),
+        Request(prompt=[200, 3, 1], max_new_tokens=5),
+    ]
+    out = eng.run(reqs)
+    assert eng.counters["preemptions"] >= 1
+    for req, res in zip(reqs, out):
+        assert res.status == OK
+        _assert_solo_prefix(params, cfg, req, res, 16)
+    assert eng.compile_cache_sizes() == {
+        "tick": 1, "chunk": 1, "set_row": 1}
+    assert eng.free_block_count() == 5
+
+
+# -- poison-request quarantine -----------------------------------------------
+
+
+def test_permanent_prefill_fault_fails_only_that_request(world):
+    """The acceptance pin: an injected permanent fault in one request's
+    prefill yields FAILED for that request only — concurrent rows finish
+    solo-exact and the engine keeps serving afterward."""
+    cfg, params = world
+    reg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      faults=reg)
+    reqs = [Request(prompt=[5, 17, 42], max_new_tokens=6),
+            Request(prompt=[7, 8, 9, 10, 11], max_new_tokens=5),
+            Request(prompt=[100, 101], max_new_tokens=4)]
+    ids = [eng.submit(r) for r in reqs]
+    reg.inject("serve.prefill", key=ids[1], permanent=True)
+    while eng.pending():
+        eng.step()
+    poisoned = eng.results[ids[1]]
+    assert poisoned.status == FAILED
+    assert isinstance(poisoned.error, PermanentFault)
+    assert list(poisoned) == []              # died before any token
+    assert eng.counters["failures"] == 1
+    for i in (0, 2):
+        assert eng.results[ids[i]].status == OK
+        _assert_solo_prefix(params, cfg, reqs[i], eng.results[ids[i]], 16)
+    # the engine keeps serving: fresh request, full parity, no retrace
+    late = Request(prompt=[42], max_new_tokens=5)
+    res = eng.run([late])[0]
+    assert res.status == OK
+    _assert_solo_prefix(params, cfg, late, res, 16)
+    assert eng.compile_cache_sizes() == {
+        "tick": 1, "chunk": 1, "set_row": 1}
+    assert eng.free_block_count() == eng.pcache.k.shape[1] - 1
+
+
+def test_permanent_tick_fault_keeps_tokens_so_far(world):
+    cfg, params = world
+    reg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      faults=reg)
+    reqs = [Request(prompt=[5, 17, 42], max_new_tokens=8),
+            Request(prompt=[7, 8], max_new_tokens=6)]
+    ids = [eng.submit(r) for r in reqs]
+    # rid 0's 4th decode readback dies permanently
+    reg.inject("serve.tick", key=ids[0], on_hit=4, permanent=True)
+    while eng.pending():
+        eng.step()
+    dead = eng.results[ids[0]]
+    assert dead.status == FAILED
+    assert isinstance(dead.error, PermanentFault)
+    assert len(dead) == 3                    # emitted before the poison
+    _assert_solo_prefix(params, cfg, reqs[0], dead, 16)
+    ok = eng.results[ids[1]]
+    assert ok.status == OK
+    _assert_solo_prefix(params, cfg, reqs[1], ok, 16)
+    assert eng.free_block_count() == eng.pcache.k.shape[1] - 1
+
+
+def test_transient_faults_retry_to_parity(world):
+    """Transient faults at every engine site (admit, prefill window,
+    decode readback) retry within bounds and the request still ends OK
+    with solo-exact tokens; the retry counter and events record it."""
+    cfg, params = world
+    reg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      faults=reg)
+    reqs = [Request(prompt=[5, 17, 42], max_new_tokens=6),
+            Request(prompt=[7, 8, 9, 10, 11], max_new_tokens=5)]
+    ids = [eng.submit(r) for r in reqs]
+    reg.inject("serve.admit", key=ids[0])                  # 1st attempt
+    reg.inject("serve.prefill", key=ids[1], on_hit=1)      # 1st window
+    reg.inject("serve.tick", key=ids[0], on_hit=2)         # 2nd readback
+    while eng.pending():
+        eng.step()
+    assert eng.counters["retries"] == 3
+    assert [e.kind for e in eng.events].count("retry") == 3
+    for rid, req in zip(ids, reqs):
+        res = eng.results[rid]
+        assert res.status == OK, (rid, res.status, res.error)
+        _assert_solo_prefix(params, cfg, req, res, 16)
+    assert eng.compile_cache_sizes() == {
+        "tick": 1, "chunk": 1, "set_row": 1}
+
+
+def test_transient_fault_exhausts_retries_to_failed(world):
+    cfg, params = world
+    reg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4,
+                      faults=reg, max_retries=1)
+    rid = eng.submit(Request(prompt=[5, 17, 42], max_new_tokens=4))
+    # fires on every prefill attempt within the retry budget
+    reg.inject("serve.prefill", key=rid, on_hit=1, count=10)
+    while eng.pending():
+        eng.step()
+    res = eng.results[rid]
+    assert res.status == FAILED
+    assert isinstance(res.error, TransientFault)
+    assert eng.counters["retries"] == 1      # bounded by max_retries
+    assert eng.free_block_count() == eng.pcache.k.shape[1] - 1
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+def test_no_progress_watchdog_raises_with_dump(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4,
+                      watchdog_steps=5)
+    eng.submit(Request(prompt=[5, 17, 42], max_new_tokens=4))
+    # simulate a block leak: the queue head can never admit and nothing
+    # is decoding, so no step makes progress
+    eng._free_blocks.clear()
+    with pytest.raises(RuntimeError, match="no scheduling progress"):
+        for _ in range(10):
+            eng.step()
+    msg = str(eng.state_dump())
+    assert "queued rid=0" in msg and "free_blocks=0" in msg
+
+
+# -- the data.producer site --------------------------------------------------
+
+
+def test_data_producer_fault_surfaces_in_consumer():
+    """An injected producer-thread fault propagates into the iterating
+    consumer (the loader's existing exception channel) instead of
+    wedging the prefetch queue."""
+    from horovod_tpu.data import ShardedLoader
+
+    x = np.arange(64, dtype=np.float32).reshape(32, 2)
+    try:
+        faults_mod.inject("data.producer", on_hit=2)
+        loader = ShardedLoader((x,), 2, shuffle=False, device_put=False)
+        it = iter(loader)
+        next(it)                             # batch 0 fine
+        with pytest.raises(TransientFault):
+            for _ in it:
+                pass
+    finally:
+        faults_mod.clear()
+    # with the registry cleared the same loader drains fully
+    assert len(list(iter(loader))) == 2

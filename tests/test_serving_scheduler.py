@@ -191,6 +191,14 @@ def test_scheduler_events_and_timeline(world, tmp_path):
     assert counters, "expected per-step counter events"
     assert set(counters[0]["args"]) == {
         "queued", "decoding", "prefilling", "free_blocks"}
+    # The lifecycle totals ride their own counter series; a clean run
+    # reports every series at zero on every step.
+    lifecycle = [ev for ev in counters if ev["name"] == "LIFECYCLE"]
+    assert lifecycle, "expected per-step LIFECYCLE counter events"
+    assert set(lifecycle[0]["args"]) == {
+        "preemptions", "timeouts", "cancellations", "rejections",
+        "retries", "failures"}
+    assert all(v == 0 for v in lifecycle[-1]["args"].values())
 
 
 def test_submit_validation(world):
