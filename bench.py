@@ -566,6 +566,69 @@ def _bench_serving_overcommit(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_prefix(hvd, on_tpu: bool) -> dict:
+    """Shared-prefix KV cache throughput (extras arm, TPU only): a
+    shared-system-prompt workload — every request opens with the same
+    long prefix, as production chat/few-shot traffic does — served by
+    the ServeEngine with ``prefix_cache=True`` vs. the same engine
+    cache-off.  The radix index turns the repeated prefill into a
+    block-table write, so the dashboard sees the hit rate, the prefill
+    tokens skipped, and tokens/sec on vs. off (the acceptance bar:
+    hit rate > 0 and on >= off).  Parity is asserted inside the
+    helper: the cache-on outputs are bit-identical to cache-off."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_prefix_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        n_slots, max_len, chunk = 2, 32, 4
+        prefix_len, n_reqs, suffix_hi, new_hi = 12, 8, 4, 6
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        # system prompt spans 3 full blocks; per-request user turns
+        # and budgets stay short, so prefill is prefix-dominated
+        prefix_len, n_reqs, suffix_hi, new_hi = 192, 32, 48, 64
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(13)
+    sys_prompt = [int(t) for t in
+                  rng.randint(1, cfg.vocab_size, size=prefix_len)]
+    reqs = []
+    for _ in range(n_reqs):
+        sl = int(rng.randint(1, suffix_hi + 1))
+        suffix = [int(t) for t in rng.randint(1, cfg.vocab_size, size=sl)]
+        new = int(rng.randint(1, new_hi + 1))
+        reqs.append(Request(prompt=sys_prompt + suffix,
+                            max_new_tokens=new))
+    r = measure_prefix_throughput(params, cfg, reqs, n_slots=n_slots,
+                                  max_len=max_len, chunk=chunk)
+    return {
+        "serve_prefix_tokens_per_sec": round(
+            r["serve_prefix_tokens_per_sec"], 1),
+        "serve_prefix_off_tokens_per_sec": round(
+            r["serve_prefix_off_tokens_per_sec"], 1),
+        "serve_prefix_speedup": round(r["serve_prefix_speedup"], 3),
+        "serve_prefix_hit_rate": round(r["serve_prefix_hit_rate"], 3),
+        "serve_prefix_tokens_skipped": int(
+            r["serve_prefix_tokens_skipped"]),
+        "serve_prefix_shape": (
+            f"s{n_slots}_len{max_len}_chunk{chunk}_pfx{prefix_len}_"
+            f"req{len(reqs)}"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1069,7 +1132,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # 2026-08-01) — then the llama arms earlier rounds recorded, then
     # newer arms.
     for fn in (_bench_fusion, _bench_serving,
-               _bench_serving_overcommit,
+               _bench_serving_overcommit, _bench_serve_prefix,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):

@@ -18,6 +18,10 @@ Named sites currently wired:
 ``serve.tick``     per decode-tick readback, per decoding row (key =
                    request id)
 ``serve.admit``    per admission attempt (key = request id)
+``serve.cache``    per prefix-cache lookup during admission (key =
+                   request id) — fires BEFORE the radix match takes
+                   any block references, so a fault quarantines to the
+                   one request while every shared block stays intact
 ``data.producer``  per batch assembled by the
                    :class:`~horovod_tpu.data.ShardedLoader` prefetch
                    thread (key = batch index)

@@ -634,6 +634,127 @@ def init_paged_cache(
     )
 
 
+class BlockPool:
+    """Host-side reference-counted allocator over the paged pool's
+    physical blocks — the free-list's successor once blocks can be
+    SHARED across slot rows (prefix caching: one physical block mapped
+    by many block-table rows).
+
+    Every physical block (1..n_blocks-1; block 0 is trash and never
+    allocated) is in exactly one of three states:
+
+    * **free** — on the free list, content garbage, allocatable;
+    * **referenced** — mapped by >= 1 live rows (``refcount(b)`` users);
+      never reclaimed while any reference remains;
+    * **cached** — zero references but *indexed* by a prefix index
+      (:class:`horovod_tpu.prefix_cache.RadixPrefixCache`): content is
+      a valid, immutable KV chunk kept for future reuse.  Cached blocks
+      sit in LRU order and are reclaimed by the index's eviction walk
+      when admission needs them — eviction of cache always precedes
+      preemption of live rows.
+
+    The pool is policy-free: it tracks states and counts; *which*
+    cached block to evict (leaf-first, LRU) is the radix index's call,
+    because evictability depends on tree structure the pool can't see.
+    All bookkeeping is host-side — device programs never observe any of
+    it (block tables change data, never shapes).
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks {n_blocks} leaves no allocatable block "
+                f"beyond trash block 0")
+        self.n_blocks = n_blocks
+        # pop() takes low ids first, matching the old free-list order so
+        # cache-off engines allocate bit-identical block layouts
+        self._free = list(range(n_blocks - 1, 0, -1))
+        self._ref: dict[int, int] = {}       # block -> live references
+        self._indexed: set[int] = set()      # owned by a prefix index
+        self._lru: dict[int, None] = {}      # zero-ref indexed, LRU order
+
+    # -- counts ------------------------------------------------------------
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def cached_count(self) -> int:
+        return len(self._lru)
+
+    def ref_count(self) -> int:
+        """Blocks currently mapped by at least one live row."""
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    # -- allocation / references -------------------------------------------
+
+    def alloc(self) -> int:
+        """Take a free block (caller increfs it when a row maps it).
+        Raises IndexError when the free list is empty — callers gate on
+        ``free_count()`` (and evict cache first when they can)."""
+        return self._free.pop()
+
+    def incref(self, block: int) -> int:
+        """One more row maps ``block``; a cached block leaves the LRU
+        (it is pinned while referenced — eviction can't touch it)."""
+        self._lru.pop(block, None)
+        n = self._ref.get(block, 0) + 1
+        self._ref[block] = n
+        return n
+
+    def decref(self, block: int) -> int:
+        """One row unmapped ``block``.  At zero references an indexed
+        block parks in the LRU cache (release-to-cache); an unindexed
+        one returns to the free list."""
+        n = self._ref[block] - 1
+        if n > 0:
+            self._ref[block] = n
+            return n
+        del self._ref[block]
+        if block in self._indexed:
+            self._lru[block] = None          # MRU end
+        else:
+            self._free.append(block)
+        return 0
+
+    # -- index ownership ----------------------------------------------------
+
+    def mark_indexed(self, block: int) -> None:
+        """A prefix index now owns ``block``'s content (it became a tree
+        node): zero-ref no longer means free, it means cached."""
+        self._indexed.add(block)
+
+    def drop_indexed(self, block: int) -> None:
+        """The index evicted ``block`` (must be zero-ref): back to the
+        free list."""
+        if block in self._ref:
+            raise RuntimeError(
+                f"evicting block {block} with {self._ref[block]} live "
+                f"references")
+        self._indexed.discard(block)
+        self._lru.pop(block, None)
+        self._free.append(block)
+
+    def lru_blocks(self) -> list[int]:
+        """Zero-ref cached blocks, least-recently-used first (the
+        eviction candidate order)."""
+        return list(self._lru)
+
+    def state_lines(self) -> list[str]:
+        """Human-readable pool picture for scheduler state dumps."""
+        shared = {b: n for b, n in sorted(self._ref.items()) if n > 1}
+        return [
+            f"block pool: free={len(self._free)} "
+            f"cached_zero_ref={len(self._lru)} "
+            f"referenced={len(self._ref)} "
+            f"of {self.n_blocks - 1} allocatable",
+            f"  lru (old->new)={list(self._lru)} shared_refcounts="
+            f"{shared if shared else '{}'}",
+        ]
+
+
 def _paged_attend(params, tokens, cfg: LlamaConfig, kv_k, kv_v,
                   qpos, wflat, gflat):
     """Shared body of the paged decode paths: scatter the chunk's K/V at

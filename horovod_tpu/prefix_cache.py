@@ -1,0 +1,241 @@
+"""Shared-prefix KV cache: a radix index over ref-counted paged blocks.
+
+Real serving fleets see the same system/few-shot prompt prefix on most
+requests; recomputing its prefill per admission is the dominant
+avoidable cost in the continuous-batching engine.  PagedAttention
+(Kwon et al., vLLM SOSP '23) showed KV can be shared across requests at
+block granularity; RadixAttention (Zheng et al., SGLang) showed an
+automatic radix-tree index over token prefixes makes the sharing
+transparent — no client-side prefix handles, just longest-prefix match
+on admission.  This module is both, mapped onto the existing
+:class:`~horovod_tpu.models.llama.PagedKVCache` block tables:
+
+* **Full, immutable blocks only.**  A physical block enters the index
+  only once every one of its ``block_size`` positions holds the KV of a
+  known token path starting at sequence position 0.  Indexed blocks are
+  never written again — a row's write frontier is kept strictly inside
+  its own private blocks (see COW below) — so sharing needs no device
+  copies and no new compiled programs: a cache hit writes different
+  block-table *data* through the engine's existing ``_set_row``
+  program.
+
+* **Radix tree keyed by token chunks.**  Each node is one full block;
+  its edge key is the ``block_size``-token tuple the block holds, so a
+  root-to-node path spells the exact token prefix (and therefore the
+  exact rotary positions) the node's KV was computed from.  Longest
+  prefix match walks the tree chunk by chunk; admission maps the hit
+  blocks straight into the new slot's block-table row and chunked
+  prefill starts at the first uncached token.
+
+* **Reference counts + LRU release-to-cache.**  Every block a live row
+  maps carries a reference (:class:`~horovod_tpu.models.llama.BlockPool`);
+  retirement *releases to cache* instead of freeing — zero-ref indexed
+  blocks park in LRU order and are reclaimed leaf-first when admission
+  runs short, always BEFORE any live decoding row is preempted.
+
+* **Copy-on-write tail.**  The block containing a request's write
+  frontier must be private.  A match is therefore capped at
+  ``(len(prompt) - 1) // block_size`` blocks: at least the prompt's
+  last token always re-prefills (its logits seed decoding — KV reuse
+  alone can't produce them), and when the cap bites (prompt ends
+  exactly on a block boundary, fully cached), the final shared block is
+  "copied" by *recomputing* its tokens into a fresh private block —
+  deterministic prefill makes the copy bit-identical, and the shared
+  original is never touched.  Divergent continuations after a common
+  prefix therefore never interfere: each row appends into its own tail.
+
+The whole subsystem is host-side bookkeeping; parity is exact by
+construction (same KV values at the same positions, same programs), and
+is pinned by ``tests/test_prefix_cache.py`` against cache-off runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+from horovod_tpu.models.llama import BlockPool
+
+
+@dataclasses.dataclass
+class RadixNode:
+    """One full, immutable KV block on the prefix tree.  ``key`` is the
+    block's token chunk (the edge label from ``parent``); the
+    root-to-here key concatenation is the token path whose KV the block
+    holds at positions ``[depth * block_size, (depth+1) * block_size)``."""
+
+    block: int
+    key: tuple[int, ...]
+    parent: "RadixNode | None"
+    children: dict[tuple[int, ...], "RadixNode"] = dataclasses.field(
+        default_factory=dict)
+
+
+class RadixPrefixCache:
+    """The prefix index over a :class:`BlockPool`.
+
+    The cache never allocates: callers hand it blocks that are already
+    written (``insert``), and it hands back shared blocks with a
+    reference taken (``acquire``).  Eviction (``evict``) walks zero-ref
+    LRU blocks leaf-first and returns them to the pool's free list;
+    interior nodes become leaves as their children go, so a cold
+    subtree drains oldest-leaf-first without ever orphaning a path.
+
+    ``stats``: cumulative counters — ``hits`` (acquire calls matching
+    >= 1 block), ``misses``, ``blocks_reused``, ``tokens_skipped``
+    (``blocks_reused * block_size``: prefill positions admission did
+    not recompute), ``inserted_blocks``, ``evicted_blocks``.
+    """
+
+    def __init__(self, pool: BlockPool, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size {block_size} must be >= 1")
+        self.pool = pool
+        self.block_size = block_size
+        self._root = RadixNode(block=0, key=(), parent=None)
+        self._nodes: dict[int, RadixNode] = {}     # block -> node
+        self.stats = {"hits": 0, "misses": 0, "blocks_reused": 0,
+                      "tokens_skipped": 0, "inserted_blocks": 0,
+                      "evicted_blocks": 0}
+
+    # -- introspection -----------------------------------------------------
+
+    def indexed_blocks(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, block: int) -> bool:
+        return block in self._nodes
+
+    def path_blocks(self, tokens: list[int]) -> list[int]:
+        """Longest-prefix match WITHOUT taking references (read-only
+        peek, for tests/dumps): block ids covering the longest fully
+        indexed chunk path of ``tokens``."""
+        return [n.block for n in self._walk(tokens, len(tokens))]
+
+    # -- the hit path ------------------------------------------------------
+
+    def _walk(self, tokens: list[int], max_tokens: int) -> list[RadixNode]:
+        bs = self.block_size
+        node, out = self._root, []
+        for i in range(min(len(tokens), max_tokens) // bs):
+            child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
+    def acquire(self, tokens: list[int]) -> list[int]:
+        """Longest-prefix match for an admission, references taken.
+
+        Returns the physical blocks covering the longest indexed chunk
+        path of ``tokens[:-1]`` — capped one token short so the block
+        holding the write frontier is always private (the COW rule: a
+        full hit recomputes its final chunk into a fresh block rather
+        than mutating the shared one).  Each returned block is
+        incref'd — pinned against eviction — until ``release``."""
+        matched = self._walk(tokens, max(len(tokens) - 1, 0))
+        blocks = [n.block for n in matched]
+        for b in blocks:
+            self.pool.incref(b)
+        if blocks:
+            self.stats["hits"] += 1
+            self.stats["blocks_reused"] += len(blocks)
+            self.stats["tokens_skipped"] += len(blocks) * self.block_size
+        else:
+            self.stats["misses"] += 1
+        return blocks
+
+    def release(self, blocks: Iterable[int]) -> None:
+        """Drop one reference per block (row retirement / requeue /
+        failed admission).  Indexed blocks reaching zero references
+        park in the pool's LRU cache; private ones free."""
+        for b in blocks:
+            self.pool.decref(b)
+
+    # -- the insert path ---------------------------------------------------
+
+    def insert(self, tokens: list[int], blocks: list[int],
+               frontier: int) -> int:
+        """Register a retiring row's full blocks (release-to-cache).
+
+        ``tokens`` is the row's complete token path from position 0
+        (replay prompt + emitted output), ``blocks`` its physical
+        blocks in table order, ``frontier`` how many positions of the
+        path are actually written (<= len(tokens)).  Every fully
+        written block extends the tree; a chunk path that already has a
+        node keeps the incumbent block (the retiring row's duplicate
+        stays unindexed and frees on release).  Returns how many blocks
+        were newly indexed.  The caller still owns its references —
+        call ``release`` afterwards."""
+        bs = self.block_size
+        node, added = self._root, 0
+        for i in range(min(frontier, len(tokens)) // bs):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = RadixNode(block=blocks[i], key=key, parent=node)
+                node.children[key] = child
+                self._nodes[blocks[i]] = child
+                self.pool.mark_indexed(blocks[i])
+                added += 1
+            node = child
+        self.stats["inserted_blocks"] += added
+        return added
+
+    # -- eviction ----------------------------------------------------------
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` cached blocks, LRU leaf-first.
+
+        Only zero-reference leaves are evictable (an interior node's
+        block must outlive its descendants or their paths would dangle;
+        a referenced block is pinned by live rows).  Evicting a leaf
+        can turn its parent into a leaf, so the walk repeats until the
+        quota is met or a full pass frees nothing.  Returns the number
+        of blocks returned to the free list."""
+        freed = 0
+        while freed < n_blocks:
+            progress = False
+            for b in self.pool.lru_blocks():          # oldest first
+                node = self._nodes[b]
+                if node.children:
+                    continue                          # interior: skip
+                del node.parent.children[node.key]
+                del self._nodes[b]
+                self.pool.drop_indexed(b)             # -> free list
+                freed += 1
+                progress = True
+                if freed >= n_blocks:
+                    break
+            if not progress:
+                break
+        self.stats["evicted_blocks"] += freed
+        return freed
+
+    # -- debugging ---------------------------------------------------------
+
+    def check_consistency(self) -> None:
+        """Structural invariants (the env-gated debug walk): every
+        indexed block has a tree node reachable from the root, parents
+        of every node are indexed (no dangling paths), and zero-ref
+        indexed blocks are exactly the pool's LRU set."""
+        seen: dict[int, RadixNode] = {}
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.block in seen:
+                raise AssertionError(
+                    f"block {n.block} appears at two tree positions")
+            seen[n.block] = n
+            stack.extend(n.children.values())
+        if seen.keys() != self._nodes.keys():
+            raise AssertionError(
+                f"node map out of sync with tree: map-only="
+                f"{set(self._nodes) - set(seen)} tree-only="
+                f"{set(seen) - set(self._nodes)}")
+        lru = set(self.pool.lru_blocks())
+        zero_ref = {b for b in seen if self.pool.refcount(b) == 0}
+        if lru != zero_ref:
+            raise AssertionError(
+                f"LRU set {lru} != zero-ref indexed set {zero_ref}")

@@ -50,7 +50,12 @@ class Request:
 
     ``prefix``: a :class:`PrefixCache` (shared system prompt) this
     request continues from; ``prompt`` is then just the suffix (the user
-    turn) and the prefix's K/V are spliced instead of recomputed.
+    turn) and the prefix's K/V are spliced instead of recomputed.  This
+    explicit-handle splice is :class:`ContinuousBatcher`-only;
+    :class:`~horovod_tpu.serving_scheduler.ServeEngine` instead reuses
+    prefixes transparently (``prefix_cache=True``: radix-indexed,
+    ref-counted paged blocks — see :mod:`horovod_tpu.prefix_cache`), so
+    engine requests always carry the full prompt.
 
     ``temperature``: per-request override of the pool temperature.  A
     sampling pool serves greedy requests via 0.0; the reverse is not

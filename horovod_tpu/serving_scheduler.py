@@ -42,9 +42,33 @@ schedulers treat as first-class scheduler transitions, not crashes):
   reuse the replay path), then a ``FAILED`` result carrying the
   exception.  All other rows keep serving;
 * deterministic fault injection via :mod:`horovod_tpu.faults` sites
-  ``serve.admit`` / ``serve.prefill`` / ``serve.tick``, and a
-  no-progress watchdog that raises with a full scheduler-state dump
-  instead of spinning ``run()`` forever.
+  ``serve.admit`` / ``serve.prefill`` / ``serve.tick`` /
+  ``serve.cache``, and a no-progress watchdog that raises with a full
+  scheduler-state dump instead of spinning ``run()`` forever.
+
+Shared-prefix KV reuse (``prefix_cache=True``; PagedAttention block
+sharing + RadixAttention-style automatic indexing — see
+:mod:`horovod_tpu.prefix_cache`):
+
+* physical blocks become **reference-counted**
+  (:class:`~horovod_tpu.models.llama.BlockPool`) and retirement
+  **releases to cache** instead of freeing: every full, immutable
+  block of a cleanly finished row is registered in a radix tree keyed
+  by its token-chunk path, parking zero-ref blocks in LRU order;
+* admission does a **longest-prefix match** and maps the hit blocks
+  straight into the new slot's block-table row — chunked prefill
+  starts at the first uncached token (a full hit recomputes only the
+  final chunk: the copy-on-write rule keeping the write-frontier block
+  private, and the source of the logits that seed decoding);
+* under KV pressure, **cache evicts before rows preempt**: admission
+  reclaims zero-ref LRU leaves first, and only a starved head that
+  outlasts eviction triggers row preemption.  A preempted row's blocks
+  release-to-cache too, so its replay re-admits through the cache and
+  is nearly free;
+* none of it adds device programs: cache hits change block-table
+  *data*, never shapes — the same three jit signatures serve, pinned
+  by ``compile_cache_sizes()``, and every output stays bit-identical
+  to the cache-off solo greedy run.
 
 Scheduler invariants:
 
@@ -68,6 +92,7 @@ The engine is greedy-only; sampling pools stay on
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Any
@@ -78,6 +103,7 @@ import numpy as np
 
 from horovod_tpu import faults as faults_mod
 from horovod_tpu.models import llama
+from horovod_tpu.prefix_cache import RadixPrefixCache
 from horovod_tpu.serving import (
     CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request, RequestResult,
 )
@@ -88,10 +114,11 @@ FREE, PREFILL, DECODE = "free", "prefill", "decode"
 @dataclasses.dataclass
 class SchedulerEvent:
     """One scheduler decision, for tests/telemetry: ``kind`` is
-    ``"admit"``, ``"recycle"`` (OK retirement), ``"preempt"``,
-    ``"retry"``, ``"cancel"``, ``"timeout"``, ``"reject"`` or
-    ``"fail"``; ``step`` the engine step index; ``slot`` is -1 for
-    queue-side events (reject, queued cancel/timeout, admit retry)."""
+    ``"admit"``, ``"hit"`` (admission with a prefix-cache match),
+    ``"recycle"`` (OK retirement), ``"preempt"``, ``"retry"``,
+    ``"cancel"``, ``"timeout"``, ``"reject"`` or ``"fail"``; ``step``
+    the engine step index; ``slot`` is -1 for queue-side events
+    (reject, queued cancel/timeout, admit retry)."""
 
     kind: str
     step: int
@@ -126,8 +153,10 @@ class _Slot:
     budget: int = 0
     eos: int | None = None
     out: list[int] = dataclasses.field(default_factory=list)
-    n_blocks: int = 0                    # blocks allocated to this slot
+    n_blocks: int = 0                    # blocks mapped by this slot
     blocks: list[int] = dataclasses.field(default_factory=list)
+    base: int = 0                        # cached-prefix positions skipped
+    n_hit: int = 0                       # leading shared (hit) blocks
     req: Request | None = None           # original request (for replay)
     prior: list[int] = dataclasses.field(default_factory=list)
     retries: int = 0
@@ -168,8 +197,22 @@ class ServeEngine:
     ``RuntimeError`` with a scheduler-state dump instead of letting
     ``run()`` spin forever.  ``faults``: a
     :class:`~horovod_tpu.faults.FaultRegistry` consulted at the
-    ``serve.admit`` / ``serve.prefill`` / ``serve.tick`` sites (defaults
-    to the shared registry, which is a no-op unless armed).
+    ``serve.admit`` / ``serve.prefill`` / ``serve.tick`` /
+    ``serve.cache`` sites (defaults to the shared registry, which is a
+    no-op unless armed).
+
+    ``prefix_cache``: enable transparent shared-prefix KV reuse
+    (:mod:`horovod_tpu.prefix_cache`) — admission longest-prefix-matches
+    each prompt against the radix index of previously served requests
+    and maps the hit blocks straight into the new row, so chunked
+    prefill starts at the first uncached token; retirement releases
+    blocks *to the cache* (zero-ref blocks park in LRU order) instead
+    of freeing, and admission under KV pressure evicts cached blocks
+    before any decoding row is preempted.  Off by default: block
+    accounting is then exactly the classic free list and every code
+    path is unchanged.  Set ``HVD_TPU_VERIFY_BLOCKS=1`` to walk the
+    block tables after every step asserting refcount consistency (debug
+    aid; O(slots * blocks) host work per step).
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
@@ -180,7 +223,8 @@ class ServeEngine:
                  preempt_after: int | None = None,
                  max_retries: int = 2,
                  watchdog_steps: int = 256,
-                 faults: "faults_mod.FaultRegistry | None" = None):
+                 faults: "faults_mod.FaultRegistry | None" = None,
+                 prefix_cache: bool = False):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
                              f"{max_len}]")
@@ -205,8 +249,18 @@ class ServeEngine:
             n_blocks=n_blocks)
         self.blocks_per_slot = self.pcache.block_table.shape[1]
         total = self.pcache.k.shape[1]
-        # block 0 is trash — never allocated; pop() takes low ids first
-        self._free_blocks = list(range(total - 1, 0, -1))
+        # block 0 is trash — never allocated; the pool's free list pops
+        # low ids first, matching the classic free-list order
+        self.pool = llama.BlockPool(total)
+        # legacy alias: the SAME list object the pool allocates from
+        # (white-box tests drain it to force block starvation)
+        self._free_blocks = self.pool._free
+        self.prefix = (RadixPrefixCache(self.pool, block_size)
+                       if prefix_cache else None)
+        self.prefix_counters = {"hits": 0, "blocks_reused": 0,
+                                "tokens_skipped": 0, "evictions": 0}
+        self._verify_blocks = os.environ.get(
+            "HVD_TPU_VERIFY_BLOCKS", "") == "1"
         self._trash_row = np.zeros((self.blocks_per_slot,), np.int32)
         self.last_logits = jnp.zeros((n_slots, cfg.vocab_size),
                                      jnp.float32)
@@ -249,15 +303,17 @@ class ServeEngine:
             return pcache, last_logits
 
         @partial(jax.jit, donate_argnums=(0,))
-        def _set_row(pcache, slot, row):
+        def _set_row(pcache, slot, row, length):
             # admission/retirement table write: swaps which physical
-            # blocks a slot row maps to and rewinds its length — data
+            # blocks a slot row maps to and sets its length — data
             # only, so slot recycling (and every lifecycle transition:
             # preempt, cancel, timeout, fail) reuses the same compiled
-            # programs
+            # programs.  `length` is 0 except on a prefix-cache hit,
+            # where it is the cached frontier so the first prefill
+            # window continues from the first uncached token.
             return pcache._replace(
                 block_table=pcache.block_table.at[slot].set(row),
-                length=pcache.length.at[slot].set(0))
+                length=pcache.length.at[slot].set(length))
 
         self._tick = _tick
         self._chunk = _chunk
@@ -277,6 +333,10 @@ class ServeEngine:
     def free_block_count(self) -> int:
         return len(self._free_blocks)
 
+    def cached_block_count(self) -> int:
+        """Zero-ref blocks parked in the prefix cache (0 without it)."""
+        return self.pool.cached_count()
+
     def pending(self) -> bool:
         return bool(self._queue) or any(
             s.state != FREE for s in self._slots)
@@ -289,6 +349,13 @@ class ServeEngine:
             f"{self.pcache.k.shape[1] - 1} starve_steps="
             f"{self._starve_steps} counters={self.counters}",
         ]
+        lines += ["  " + ln for ln in self.pool.state_lines()]
+        if self.prefix is not None:
+            lines.append(
+                f"  prefix cache: indexed="
+                f"{self.prefix.indexed_blocks()} "
+                f"counters={self.prefix_counters} "
+                f"stats={self.prefix.stats}")
         for e in self._queue:
             lines.append(
                 f"  queued rid={e.rid} prompt={len(e.req.prompt)} "
@@ -301,8 +368,8 @@ class ServeEngine:
                     "" if s.state == FREE else
                     f" rid={s.request_id} w={s.w_done}/{s.n_win} "
                     f"out={len(s.out)} budget={s.budget} "
-                    f"blocks={s.n_blocks} retries={s.retries} "
-                    f"wait={s.wait_steps}"))
+                    f"blocks={s.n_blocks} shared={s.n_hit} "
+                    f"retries={s.retries} wait={s.wait_steps}"))
         return "\n".join(lines)
 
     # -- queue -------------------------------------------------------------
@@ -371,26 +438,42 @@ class ServeEngine:
 
     # -- scheduling --------------------------------------------------------
 
-    def _admit_entry(self, e: _QueueEntry, slot: int) -> None:
+    def _admit_entry(self, e: _QueueEntry, slot: int,
+                     hit: list[int] | None = None) -> None:
+        """Map a queue entry into a free slot.  ``hit`` is the
+        prefix-cache match (already referenced by ``acquire``): its
+        blocks lead the row's block table and prefill starts at the
+        first position past them — the match is capped so the write
+        frontier always lands in a freshly allocated private block
+        (the COW rule; see :mod:`horovod_tpu.prefix_cache`)."""
+        hit = hit or []
         prompt = list(e.req.prompt) + list(e.prior)
         L = len(prompt)
         need = self._need_blocks(e.req)
+        base = len(hit) * self.block_size
         s = self._slots[slot]
-        blocks = [self._free_blocks.pop() for _ in range(need)]
+        blocks = list(hit)
+        for _ in range(need - len(hit)):
+            b = self.pool.alloc()
+            self.pool.incref(b)
+            blocks.append(b)
         row = self._trash_row.copy()
         row[:need] = blocks
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
-            jnp.asarray(row))
-        n_win = -(-L // self.chunk)
+            jnp.asarray(row), jnp.asarray(base, jnp.int32))
+        rem = L - base                    # tokens still to prefill (>= 1)
+        n_win = -(-rem // self.chunk)
         padded = np.zeros((1, n_win * self.chunk), np.int32)
-        padded[0, :L] = prompt
+        padded[0, :rem] = prompt[base:]
         s.state = PREFILL
         s.request_id = e.rid
         s.padded = padded
         s.n_win = n_win
         s.w_done = 0
         s.true_len = L
+        s.base = base
+        s.n_hit = len(hit)
         s.budget = e.req.max_new_tokens - len(e.prior)
         s.eos = e.req.eos_id
         s.out = []
@@ -404,15 +487,25 @@ class ServeEngine:
         s.admit_seq = self._admit_seq
         self._admit_seq += 1
         self._event("admit", slot, e.rid)
+        if hit:
+            self.prefix_counters["hits"] += 1
+            self.prefix_counters["blocks_reused"] += len(hit)
+            self.prefix_counters["tokens_skipped"] += base
+            self._event("hit", slot, e.rid)
 
     def _admit_ready(self) -> tuple[int, int | None]:
         """FIFO admission: move queued requests into free slots while
         both a slot and enough cache blocks are available.  Head-of-line
         blocking on BLOCK pressure is deliberate — FIFO keeps
         per-request latency fair (and feeds the preemption trigger);
-        entries serving a retry backoff are skipped past.  Returns
-        ``(admitted, starved_need)`` — the block count the stalled head
-        needs, or None when nothing block-starved."""
+        entries serving a retry backoff are skipped past.  With the
+        prefix cache on, each candidate first longest-prefix-matches
+        (``serve.cache`` faults quarantine to that request alone —
+        shared blocks are untouched) and zero-ref cached blocks are
+        evicted LRU-leaf-first to cover any shortfall before the head
+        counts as starved.  Returns ``(admitted, starved_need)`` — the
+        NEW block count the stalled head needs (its cache hit already
+        discounted), or None when nothing block-starved."""
         admitted = 0
         i = 0
         while i < len(self._queue):
@@ -425,11 +518,40 @@ class ServeEngine:
                 i += 1
                 continue
             need = self._need_blocks(e.req)
-            if need > len(self._free_blocks):
-                return admitted, need     # blocks free on retirement
+            hit: list[int] = []
+            if self.prefix is not None:
+                try:
+                    self.faults.check("serve.cache", key=e.rid)
+                    hit = self.prefix.acquire(
+                        list(e.req.prompt) + list(e.prior))
+                except Exception as exc:
+                    # quarantine: nothing was referenced, the index and
+                    # every shared block are intact — only this request
+                    # retries or fails
+                    if (isinstance(exc, faults_mod.PermanentFault)
+                            or e.retries >= self.max_retries):
+                        self._queue.pop(i)
+                        self._finish_queued(e, FAILED, exc)
+                    else:
+                        e.retries += 1
+                        e.wait_steps = 2 ** e.retries
+                        self.counters["retries"] += 1
+                        self._event("retry", -1, e.rid)
+                        i += 1
+                    continue
+                short = (need - len(hit)) - self.pool.free_count()
+                if short > 0:             # cache evicts before rows do
+                    self.prefix_counters["evictions"] += \
+                        self.prefix.evict(short)
+            if need - len(hit) > len(self._free_blocks):
+                if hit:                   # hit blocks re-park in LRU
+                    self.prefix.release(reversed(hit))
+                return admitted, need - len(hit)
             try:
                 self.faults.check("serve.admit", key=e.rid)
             except Exception as exc:
+                if hit:
+                    self.prefix.release(reversed(hit))
                 if (isinstance(exc, faults_mod.PermanentFault)
                         or e.retries >= self.max_retries):
                     self._queue.pop(i)
@@ -442,7 +564,7 @@ class ServeEngine:
                     i += 1
                 continue
             self._queue.pop(i)
-            self._admit_entry(e, free[0])
+            self._admit_entry(e, free[0], hit)
             admitted += 1
         return admitted, None
 
@@ -454,11 +576,27 @@ class ServeEngine:
         n_win = -(-self._replay_len(s) // self.chunk)
         return n_win * self.chunk <= self.max_len
 
+    def _release_row_blocks(self, s: _Slot, *, register: bool) -> None:
+        """Drop a retiring row's block references.  With the prefix
+        cache on and ``register`` set (OK retirement or a requeue whose
+        KV is known-good), the row's fully written blocks first join
+        the radix index — release-to-cache — so zero-ref blocks park in
+        LRU order instead of freeing; otherwise (cache off, or a FAILED
+        / expired row whose frontier is not trusted) references drop
+        straight back toward the free list, in the classic order."""
+        if self.prefix is not None and register and s.req is not None:
+            toks = (list(s.req.prompt) + list(s.prior) + list(s.out))
+            self.prefix.insert(toks, s.blocks, s.true_len + len(s.out))
+        for b in reversed(s.blocks):
+            self.pool.decref(b)
+
     def _requeue(self, slot: int, *, retried: bool) -> None:
         """Free a row and put its request back in the queue with
         ``prompt + out`` as the replay prompt (preemption, or a decode
         retry — which replays rather than re-ticking because the faulted
-        tick already advanced the row's cache position)."""
+        tick already advanced the row's cache position).  With the
+        prefix cache on the row's KV releases to cache, so the replay
+        re-admits through a longest-prefix hit and is nearly free."""
         s = self._slots[slot]
         entry = _QueueEntry(
             rid=s.request_id, req=s.req,
@@ -466,20 +604,29 @@ class ServeEngine:
             retries=s.retries + (1 if retried else 0),
             wait_steps=2 ** (s.retries + 1) if retried else 0,
             deadline=s.deadline)
-        self._free_blocks.extend(reversed(s.blocks))
+        self._release_row_blocks(s, register=True)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
-            jnp.asarray(self._trash_row))
+            jnp.asarray(self._trash_row), jnp.asarray(0, jnp.int32))
         self._slots[slot] = _Slot()
         self._queue.append(entry)
 
     def _preempt(self, need: int) -> int:
-        """Preempt youngest decoding rows until the starved head's
-        ``need`` blocks are free (or no candidate remains).  Preempted
-        requests re-queue for replay; greedy determinism makes their
-        resumed output bit-identical to the uninterrupted run."""
+        """Free blocks for a starved head: evict zero-ref cached blocks
+        first (they hold no live work), then preempt youngest decoding
+        rows until ``need`` blocks are free (or no candidate remains).
+        Preempted requests re-queue for replay; greedy determinism
+        makes their resumed output bit-identical.  A preempted row's
+        blocks release-to-cache, so the loop re-evicts them on the next
+        pass — preemption still converges on a cache-on engine."""
         preempted = 0
         while len(self._free_blocks) < need:
+            if self.prefix is not None:
+                evicted = self.prefix.evict(
+                    need - len(self._free_blocks))
+                if evicted:
+                    self.prefix_counters["evictions"] += evicted
+                    continue
             cands = [(s.admit_seq, i) for i, s in enumerate(self._slots)
                      if s.state == DECODE and self._replayable(s)]
             if not cands:
@@ -493,17 +640,18 @@ class ServeEngine:
 
     def _terminate(self, slot: int, status: str,
                    error: BaseException | None = None) -> RequestResult:
-        """Retire a row with a terminal status: blocks back to the pool,
-        row to the trash block (the same fixed-signature table write for
-        every status — OK, TIMEOUT, CANCELLED, FAILED)."""
+        """Retire a row with a terminal status: blocks back to the pool
+        (release-to-cache on a clean OK finish when the prefix cache is
+        on), row to the trash block (the same fixed-signature table
+        write for every status — OK, TIMEOUT, CANCELLED, FAILED)."""
         s = self._slots[slot]
         res = RequestResult(list(s.prior) + list(s.out), status, error)
         self.results[s.request_id] = res
         self._finished[s.request_id] = res
-        self._free_blocks.extend(reversed(s.blocks))
+        self._release_row_blocks(s, register=status == OK)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
-            jnp.asarray(self._trash_row))
+            jnp.asarray(self._trash_row), jnp.asarray(0, jnp.int32))
         kind = {OK: "recycle", TIMEOUT: "timeout",
                 CANCELLED: "cancel", FAILED: "fail"}[status]
         self._event(kind, slot, s.request_id)
@@ -587,6 +735,63 @@ class ServeEngine:
         if self.timeline is not None:
             self.timeline.instant("serving.scheduler", kind.upper())
 
+    def _check_block_invariants(self) -> None:
+        """The ``HVD_TPU_VERIFY_BLOCKS=1`` debug walk: block tables,
+        slot bookkeeping and the pool must agree after every step —
+        each live row's table row is exactly its block list (trash
+        elsewhere), no live row references a freed block or trash,
+        every block's pool refcount equals the number of rows mapping
+        it, every pool reference belongs to some live row, the radix
+        index is structurally sound, and free + cached + referenced
+        blocks account for the whole pool."""
+        table = np.asarray(self.pcache.block_table)
+        free = set(self._free_blocks)
+        usage: dict[int, int] = {}
+        for slot, s in enumerate(self._slots):
+            row = table[slot]
+            if s.state == FREE:
+                if row.any():
+                    raise AssertionError(
+                        f"free slot {slot} maps blocks "
+                        f"{[int(b) for b in row if b]}")
+                continue
+            if [int(b) for b in row[:s.n_blocks]] != s.blocks:
+                raise AssertionError(
+                    f"slot {slot} table row {row[:s.n_blocks]} != "
+                    f"bookkeeping {s.blocks}")
+            if row[s.n_blocks:].any():
+                raise AssertionError(
+                    f"slot {slot} maps blocks beyond its "
+                    f"{s.n_blocks} allocated")
+            for b in s.blocks:
+                if b == 0:
+                    raise AssertionError(
+                        f"slot {slot} maps the trash block")
+                if b in free:
+                    raise AssertionError(
+                        f"live slot {slot} references freed block {b}")
+                usage[b] = usage.get(b, 0) + 1
+        for b, n in usage.items():
+            if self.pool.refcount(b) != n:
+                raise AssertionError(
+                    f"block {b}: {n} rows map it but pool refcount is "
+                    f"{self.pool.refcount(b)}")
+        for b in self.pool._ref:
+            if b not in usage:
+                raise AssertionError(
+                    f"block {b} holds {self.pool.refcount(b)} pool "
+                    f"references but no live row maps it")
+        if self.prefix is not None:
+            self.prefix.check_consistency()
+        total = self.pcache.k.shape[1] - 1
+        accounted = (len(free) + self.pool.cached_count()
+                     + len(self.pool._ref))
+        if accounted != total:
+            raise AssertionError(
+                f"pool accounting leak: free={len(free)} "
+                f"cached={self.pool.cached_count()} "
+                f"referenced={len(self.pool._ref)} != {total}")
+
     def step(self) -> dict[int, RequestResult]:
         """One engine step: expire deadlines, admit (preempting for a
         starved head if enabled), run one prefill window per admitting
@@ -641,8 +846,13 @@ class ServeEngine:
             w = s.w_done
             final = w == s.n_win - 1
             toks = s.padded[:, w * self.chunk:(w + 1) * self.chunk]
-            new_len = s.true_len if final else (w + 1) * self.chunk
-            sel = s.true_len - 1 - w * self.chunk if final else 0
+            # windows cover prompt[base:] — a prefix-cache hit rewound
+            # nothing: the row's length started at base, so positions
+            # [0, base) are the shared blocks' KV, never rewritten
+            new_len = (s.true_len if final
+                       else s.base + (w + 1) * self.chunk)
+            sel = (s.true_len - 1 - s.base - w * self.chunk
+                   if final else 0)
             try:
                 self.faults.check("serve.prefill", key=s.request_id)
                 self.pcache, self.last_logits = self._chunk(
@@ -701,6 +911,12 @@ class ServeEngine:
                  "free_blocks": len(self._free_blocks)})
             self.timeline.counter(
                 "serving.scheduler", "LIFECYCLE", dict(self.counters))
+            if self.prefix is not None:
+                self.timeline.counter(
+                    "serving.scheduler", "PREFIX",
+                    dict(self.prefix_counters))
+        if self._verify_blocks:
+            self._check_block_invariants()
         if self.pending() and progress == 0:
             self._idle_steps += 1
             if self._idle_steps >= self.watchdog_steps:
@@ -803,6 +1019,69 @@ def measure_throughput(
         "static_tokens_per_sec": n_tokens / t_static,
         "serve_vs_static_ratio": t_static / t_serve,
         "preemptions": eng.counters["preemptions"] - preempt0,
+        "tokens": n_tokens,
+        "n_requests": len(requests),
+        "n_slots": n_slots,
+        "max_len": max_len,
+        "chunk": chunk,
+    }
+
+
+def measure_prefix_throughput(
+    params: dict, cfg: llama.LlamaConfig, requests: list[Request], *,
+    n_slots: int, max_len: int, chunk: int,
+    block_size: int | None = None, n_blocks: int | None = None,
+) -> dict:
+    """Prefix-cache-on vs cache-off throughput on one workload (the
+    ``serve_prefix_*`` bench metrics).
+
+    Both engines serve the same queue; the cache-on engine is warmed by
+    a full untimed pass (compiles every program AND populates the radix
+    index — the steady state of a server that has seen its system
+    prompt before), mirrored by an untimed cache-off warmup, so the
+    timed passes compare prefill-skipping against recompute on equal
+    footing.  Outputs are asserted token-identical between the two
+    engines (the parity guarantee).  Returns
+    ``serve_prefix_tokens_per_sec`` (cache on),
+    ``serve_prefix_off_tokens_per_sec``, ``serve_prefix_speedup``,
+    ``serve_prefix_hit_rate`` (admissions with >= 1 reused block over
+    all admissions, timed pass), ``serve_prefix_tokens_skipped`` and
+    workload shape fields.
+    """
+    if not requests:
+        raise ValueError("empty workload")
+    kw = dict(n_slots=n_slots, max_len=max_len, chunk=chunk,
+              block_size=block_size, n_blocks=n_blocks)
+    timings: dict[bool, float] = {}
+    outputs: dict[bool, list[RequestResult]] = {}
+    hit_rate = 0.0
+    tokens_skipped = 0
+    n_tokens = 0
+    for cache_on in (False, True):
+        eng = ServeEngine(params, cfg, prefix_cache=cache_on, **kw)
+        warm = eng.run(requests)
+        assert all(r.ok for r in warm), [r.status for r in warm]
+        n_tokens = sum(len(t) for t in warm)
+        hits0 = eng.prefix_counters["hits"]
+        skip0 = eng.prefix_counters["tokens_skipped"]
+        t0 = time.perf_counter()
+        out = eng.run(requests)
+        jax.block_until_ready(eng.pcache.k)
+        timings[cache_on] = time.perf_counter() - t0
+        outputs[cache_on] = out
+        if cache_on:
+            hit_rate = ((eng.prefix_counters["hits"] - hits0)
+                        / len(requests))
+            tokens_skipped = (eng.prefix_counters["tokens_skipped"]
+                              - skip0)
+    assert [list(a) for a in outputs[True]] == \
+        [list(b) for b in outputs[False]], "prefix-cache parity broken"
+    return {
+        "serve_prefix_tokens_per_sec": n_tokens / timings[True],
+        "serve_prefix_off_tokens_per_sec": n_tokens / timings[False],
+        "serve_prefix_speedup": timings[False] / timings[True],
+        "serve_prefix_hit_rate": hit_rate,
+        "serve_prefix_tokens_skipped": tokens_skipped,
         "tokens": n_tokens,
         "n_requests": len(requests),
         "n_slots": n_slots,

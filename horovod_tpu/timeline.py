@@ -132,9 +132,11 @@ class Timeline:
                 values: dict) -> None:
         """Chrome counter event (ph 'C'): a stacked time series on the
         track — the serving scheduler emits queue depth / slot occupancy
-        / free-block counts (``SCHED``) and cumulative lifecycle totals
+        / free-block counts (``SCHED``), cumulative lifecycle totals
         (``LIFECYCLE``: preemptions / timeouts / cancellations /
-        rejections / retries / failures) per step through this, and
+        rejections / retries / failures) and, with the prefix cache on,
+        cumulative reuse totals (``PREFIX``: hits / blocks_reused /
+        tokens_skipped / evictions) per step through this, and
         speculative decoding its per-round acceptance counts.
         ``values`` maps series name → number."""
         with self._lock:

@@ -153,8 +153,9 @@ def test_engine_pipelined_dispatch_native_controller(monkeypatch):
 
 
 @pytest.mark.faults
+@pytest.mark.parametrize("prefix_cache", [False, True])
 @pytest.mark.parametrize("seed", [3, 17])
-def test_serve_engine_fault_schedule_fuzz(seed):
+def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
     """Randomized request lifecycle sweep of the ServeEngine under an
     overcommitted KV pool: seeded random prompts/budgets, one hard
     deadline, one permanently poisoned request, transient injected
@@ -163,7 +164,10 @@ def test_serve_engine_fault_schedule_fuzz(seed):
     test_serving_faults.py pin each path; this sweep interleaves them
     and checks the two global invariants: every result's tokens are a
     prefix of (and for OK, equal to) its solo ``llama.generate`` run,
-    and the non-OK statuses land exactly where the schedule says."""
+    and the non-OK statuses land exactly where the schedule says.
+    Runs with the shared-prefix cache both off (classic free-list
+    accounting) and on (release-to-cache: the same sweep must drain to
+    a consistent radix index with zero live references)."""
     import jax
 
     from horovod_tpu.faults import FaultRegistry
@@ -198,7 +202,7 @@ def test_serve_engine_fault_schedule_fuzz(seed):
     reg = FaultRegistry()
     eng = ServeEngine(params, cfg, n_slots=2, max_len=max_len, chunk=4,
                       block_size=4, n_blocks=9, preempt_after=2,
-                      faults=reg)
+                      faults=reg, prefix_cache=prefix_cache)
     ids = [eng.submit(r) for r in reqs]
     reg.inject("serve.tick", on_hit=2, permanent=True, key=ids[perm])
     reg.inject("serve.admit", on_hit=1, key=ids[tr_admit])
@@ -245,7 +249,15 @@ def test_serve_engine_fault_schedule_fuzz(seed):
     # programs and the whole block pool survive the sweep intact.
     assert eng.compile_cache_sizes() == {"tick": 1, "chunk": 1,
                                          "set_row": 1}
-    assert len(eng._free_blocks) == eng.pcache.k.shape[1] - 1
+    if prefix_cache:
+        # drained: no live references; every block is either free or
+        # parked zero-ref in a structurally sound radix index
+        assert eng.pool.ref_count() == 0
+        assert (eng.free_block_count() + eng.cached_block_count()
+                == eng.pcache.k.shape[1] - 1)
+        eng.prefix.check_consistency()
+    else:
+        assert len(eng._free_blocks) == eng.pcache.k.shape[1] - 1
 
 
 def test_engine_random_interleaving_native_controller(monkeypatch):
