@@ -499,6 +499,16 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
     return {
         "serve_tokens_per_sec": round(r["serve_tokens_per_sec"], 1),
         "serve_vs_static_ratio": round(r["serve_vs_static_ratio"], 3),
+        # Per-request latency percentiles from the metrics-on timed
+        # pass, plus what the instrumentation itself costs (metrics-on
+        # vs null-registry pass; the acceptance bound is < 2 %).
+        "serve_ttft_p50_ms": round(r["serve_ttft_p50_ms"], 3),
+        "serve_ttft_p99_ms": round(r["serve_ttft_p99_ms"], 3),
+        "serve_tpot_p50_ms": round(r["serve_tpot_p50_ms"], 3),
+        "serve_queue_wait_p99_ms": round(r["serve_queue_wait_p99_ms"], 3),
+        "serve_e2e_p99_ms": round(r["serve_e2e_p99_ms"], 3),
+        "serve_metrics_overhead_pct": round(
+            r["serve_metrics_overhead_pct"], 2),
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
                         f"req{len(reqs)}"),
     }

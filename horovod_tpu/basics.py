@@ -226,15 +226,23 @@ def _kv_topology() -> tuple[int, int] | None:
         n = jax.process_count()
         if client is None or n <= 1:
             return None
+        from horovod_tpu import metrics as metrics_mod
+
         timeout_s = _negotiate_timeout_s()
         deadline = time.monotonic() + timeout_s
         while True:
+            metrics_mod.DEFAULT.counter("hvd.negotiate_polls").inc()
             entries = client.key_value_dir_get("horovod_tpu/hostcard/")
             if len(entries) >= n:
                 break
             if time.monotonic() >= deadline:
                 import warnings
 
+                metrics_mod.DEFAULT.counter(
+                    "hvd.negotiate_timeouts").inc()
+                metrics_mod.DEFAULT.event(
+                    "hvd.negotiate_timeout", posted=len(entries),
+                    expected=n, timeout_s=timeout_s)
                 warnings.warn(
                     f"host-card negotiation timed out after "
                     f"{timeout_s:g}s: {len(entries)} of {n} peers "

@@ -46,6 +46,8 @@ import dataclasses
 import threading
 from typing import Any
 
+from horovod_tpu import metrics as metrics_mod
+
 
 class FaultError(RuntimeError):
     """Base class for injected faults; carries the site, the matched
@@ -142,6 +144,12 @@ class FaultRegistry:
             firing.fired += 1
             self.log.append((site, key, firing.seen))
             exc = PermanentFault if firing.permanent else TransientFault
+        # Outside the lock: the shared event log / counter have their own
+        # locks, and a fired fault is rare enough to afford the stamps.
+        metrics_mod.DEFAULT.counter(f"faults.fired.{site}").inc()
+        metrics_mod.DEFAULT.event(
+            "fault", site=site, key=key, hit=firing.seen,
+            permanent=firing.permanent)
         raise exc(site, key, firing.seen)
 
     def hits(self, site: str) -> int:

@@ -1,0 +1,561 @@
+"""Process-local metrics registry + structured event log for the stack.
+
+The reference ships exactly one observability surface — the Chrome-trace
+timeline (timeline.h/.cc, :mod:`horovod_tpu.timeline`) — which is
+rank-0-only, file-based, and made for eyeballs, not machines.  A
+production engine needs the request-level latency decomposition that
+Dapper (Sigelman et al. 2010) made standard and vLLM-class servers
+expose as first-class metrics: TTFT, per-output-token latency, queue
+wait, preemption/retry cost — as queryable numbers.  This module is
+that layer, shared by training and serving:
+
+* :class:`MetricsRegistry` — a thread-safe, process-local registry of
+  monotonically increasing :class:`Counter`\\ s, last-value
+  :class:`Gauge`\\ s, and fixed-log-bucket :class:`Histogram`\\ s.
+  ``snapshot()`` returns a plain nested dict (with p50/p90/p99 per
+  histogram) and ``to_prometheus()`` renders the standard Prometheus
+  text exposition, so a serving sidecar can scrape the engine with
+  zero extra dependencies.
+
+* :class:`EventLog` — an optional JSONL structured event log.  Setting
+  ``HVD_TPU_EVENT_LOG=<path>`` makes every registry created with the
+  default ``event_log="auto"`` append one JSON object per event —
+  request state transitions, fault-site hits, preemptions, prefix-cache
+  evictions — each stamped with wall-clock time and (when the emitter
+  has one) the engine step.  The log is the replayable ground truth:
+  ``tests/test_metrics.py`` pins that replaying a serve run's lines
+  reproduces the engine's lifecycle counters exactly.
+
+* :class:`Trace` — the per-request span threaded through
+  :class:`~horovod_tpu.serving_scheduler.ServeEngine` and surfaced on
+  ``RequestResult.trace``: enqueue/admit/first-token/terminal stamps
+  (``time.monotonic`` seconds, comparable within a process), plus
+  prefill-chunk / preemption / retry / prefix-reuse odometers.
+
+* Canonical name tables (:data:`TIMELINE_COUNTER_SERIES`,
+  :data:`FAULT_SITES`, :data:`LIFECYCLE_EVENT_COUNTERS`) — the single
+  source of truth ``tools/check_counter_names.py`` lints the codebase
+  against, so dashboards built on these names cannot silently drift
+  from the code.
+
+Everything here is standard library only and imports nothing else from
+``horovod_tpu`` — any module (``basics``, ``ops.eager``, ``faults``,
+``serving_scheduler``) can instrument itself without import cycles.
+The module-level :data:`DEFAULT` registry is the shared venue: the
+eager collectives engine and a default-constructed ``ServeEngine``
+both feed it, so one scrape sees training and serving side by side.
+:data:`NULL` is the no-op twin for measuring instrumentation overhead
+(``bench.py`` records the on-vs-off delta in its serve arm extras).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, IO
+
+
+# ---------------------------------------------------------------------------
+# Canonical name tables (linted by tools/check_counter_names.py).
+# ---------------------------------------------------------------------------
+
+#: Every Chrome-trace counter (``ph: "C"``) activity the codebase emits,
+#: mapped to the exact series keys its ``values`` dict carries.  A new
+#: timeline counter MUST be registered here or the lint fails the suite.
+TIMELINE_COUNTER_SERIES: dict[str, tuple[str, ...]] = {
+    # serving_scheduler.ServeEngine, per step
+    "SCHED": ("queued", "decoding", "prefilling", "free_blocks"),
+    "LIFECYCLE": ("preemptions", "timeouts", "cancellations",
+                  "rejections", "retries", "failures"),
+    "PREFIX": ("hits", "blocks_reused", "tokens_skipped", "evictions"),
+    # serving.speculative_generate, per verify round
+    "ACCEPT": ("accepted", "rows"),
+}
+
+#: Every named fault-injection site wired through
+#: :meth:`horovod_tpu.faults.FaultRegistry.check`.
+FAULT_SITES: tuple[str, ...] = (
+    "serve.admit",
+    "serve.prefill",
+    "serve.tick",
+    "serve.cache",
+    "data.producer",
+)
+
+#: Event-log ``kind`` → ``ServeEngine.counters`` key.  Replaying a JSONL
+#: event log by counting these kinds reproduces the engine's lifecycle
+#: counters exactly (pinned by tests/test_metrics.py).
+LIFECYCLE_EVENT_COUNTERS: dict[str, str] = {
+    "serve.preempt": "preemptions",
+    "serve.timeout": "timeouts",
+    "serve.cancel": "cancellations",
+    "serve.reject": "rejections",
+    "serve.retry": "retries",
+    "serve.fail": "failures",
+}
+
+
+# ---------------------------------------------------------------------------
+# Instruments.
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """A monotonically increasing integer (Prometheus ``counter``)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """A last-value-wins float (Prometheus ``gauge``)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+def log_bucket_bounds(lo: float = 1e-6, hi: float = 1e3,
+                      per_decade: int = 3) -> tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds: ``per_decade`` buckets per
+    decade from ``lo`` to ``hi`` inclusive.  The default (1 µs → 1000 s,
+    3/decade → 28 bounds) bounds every latency this stack measures with
+    <= 10^(1/3) ≈ 2.15x relative quantile error — coarse, but fixed:
+    histograms from any two processes/runs merge bucket-for-bucket."""
+    if not (0 < lo < hi):
+        raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+    n = int(round(math.log10(hi / lo) * per_decade))
+    return tuple(lo * 10 ** (i / per_decade) for i in range(n + 1))
+
+
+class Histogram:
+    """Fixed-log-bucket histogram with quantile estimation.
+
+    ``bounds`` are bucket *upper* edges (ascending); one implicit
+    overflow bucket catches everything above the last edge.  Quantiles
+    interpolate linearly inside the resolved bucket and clamp to the
+    exact observed min/max, so single-sample and narrow distributions
+    report true values instead of bucket edges.
+    """
+
+    __slots__ = ("name", "bounds", "_lock", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 bounds: tuple[float, ...] | None = None):
+        self.name = name
+        self.bounds = tuple(bounds) if bounds else log_bucket_bounds()
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError(f"histogram {name} bounds must ascend")
+        self._lock = lock
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._counts[bisect_left(self.bounds, v)] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Estimate the ``q``-quantile (``q`` in [0, 1]) from the bucket
+        counts; 0.0 when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if self._count == 0:
+            return 0.0
+        rank = q * self._count
+        cum = 0.0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self._max
+                frac = (rank - cum) / c
+                est = lo + (hi - lo) * max(frac, 0.0)
+                return min(max(est, self._min), self._max)
+            cum += c
+        return self._max
+
+    def snapshot(self) -> dict:
+        """Schema-stable summary: count/sum/min/max + p50/p90/p99."""
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                        "p50": 0.0, "p90": 0.0, "p99": 0.0}
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "p50": self._percentile_locked(0.50),
+                "p90": self._percentile_locked(0.90),
+                "p99": self._percentile_locked(0.99),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Structured event log (JSONL).
+# ---------------------------------------------------------------------------
+
+
+class EventLog:
+    """Append-only JSONL event sink: one JSON object per line, each with
+    ``ts`` (wall-clock ``time.time()``) and ``kind`` plus the emitter's
+    fields.  Flushed per line — a crashed process leaves a readable log
+    up to its last event (the postmortem property the engine watchdog
+    counts on).  Thread-safe."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        self._file: IO[str] | None = open(path, "a")
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        line = json.dumps({"ts": time.time(), "kind": kind, **fields})
+        with self._lock:
+            if self._file is None:
+                return
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse a JSONL event log (test/replay helper).  A torn final
+        line (writer died mid-write) is dropped, not fatal."""
+        out = []
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    out.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+        return out
+
+
+_ENV_LOG_LOCK = threading.Lock()
+_ENV_LOGS: dict[str, EventLog] = {}
+
+
+def env_event_log() -> EventLog | None:
+    """The shared ``HVD_TPU_EVENT_LOG`` sink, or None when unset.  One
+    :class:`EventLog` per path for the process lifetime, shared by every
+    registry resolving ``event_log="auto"`` — so concurrent emitters
+    serialize on one lock instead of interleaving file appends."""
+    path = os.environ.get("HVD_TPU_EVENT_LOG")
+    if not path:
+        return None
+    with _ENV_LOG_LOCK:
+        log = _ENV_LOGS.get(path)
+        if log is None:
+            log = _ENV_LOGS[path] = EventLog(path)
+        return log
+
+
+# ---------------------------------------------------------------------------
+# The registry.
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names allow [a-zA-Z0-9_:] — dots become
+    underscores (``serve.ttft_s`` → ``serve_ttft_s``)."""
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+class MetricsRegistry:
+    """Thread-safe, process-local home for counters/gauges/histograms.
+
+    Instruments are get-or-create by name (a name is permanently one
+    type; reusing it as another raises).  ``event_log`` controls the
+    structured-event sink: the default ``"auto"`` resolves
+    ``HVD_TPU_EVENT_LOG`` at each emit (so tests can monkeypatch the
+    env mid-process), ``None`` disables events, and an explicit
+    :class:`EventLog` pins one.
+    """
+
+    def __init__(self, event_log: "EventLog | None | str" = "auto"):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._event_log = event_log
+
+    def _get(self, table: dict, name: str, factory) -> Any:
+        with self._lock:
+            inst = None
+            for t in (self._counters, self._gauges, self._histograms):
+                if name in t:
+                    inst = t[name]
+                    break
+            if inst is None:
+                inst = table[name] = factory()
+            elif table.get(name) is not inst:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}")
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name,
+                         lambda: Counter(name, threading.Lock()))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name,
+                         lambda: Gauge(name, threading.Lock()))
+
+    def histogram(self, name: str,
+                  bounds: tuple[float, ...] | None = None) -> Histogram:
+        return self._get(self._histograms, name,
+                         lambda: Histogram(name, threading.Lock(), bounds))
+
+    # -- events ------------------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Emit one structured event to the configured sink (no-op when
+        no sink is configured)."""
+        log = self._event_log
+        if log == "auto":
+            log = env_event_log()
+        if log is not None:
+            log.emit(kind, **fields)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain nested dict of every instrument — JSON-serializable,
+        schema-stable (``counters`` / ``gauges`` / ``histograms`` with
+        count/sum/min/max/p50/p90/p99 each)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: g.value for n, g in sorted(gauges.items())},
+            "histograms": {n: h.snapshot()
+                           for n, h in sorted(histograms.items())},
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format, version 0.0.4: ``# TYPE``
+        lines plus samples; histograms render cumulative ``_bucket``
+        series with ``le`` labels, ``_sum`` and ``_count``."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        lines: list[str] = []
+        for name, c in sorted(counters.items()):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {c.value}")
+        for name, g in sorted(gauges.items()):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {g.value:g}")
+        for name, h in sorted(histograms.items()):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} histogram")
+            with h._lock:
+                cum = 0
+                for edge, c in zip(h.bounds, h._counts):
+                    cum += c
+                    lines.append(f'{pn}_bucket{{le="{edge:g}"}} {cum}')
+                lines.append(f'{pn}_bucket{{le="+Inf"}} {h._count}')
+                lines.append(f"{pn}_sum {h._sum:g}")
+                lines.append(f"{pn}_count {h._count}")
+        return "\n".join(lines) + "\n"
+
+
+class _NullCounter(Counter):
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+
+class _NullGauge(Gauge):
+    __slots__ = ()
+
+    def set(self, v: float) -> None:
+        pass
+
+
+class _NullHistogram(Histogram):
+    __slots__ = ()
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+class NullRegistry(MetricsRegistry):
+    """A registry whose instruments discard everything — attach it to
+    measure the cost of instrumentation itself (the bench's metrics-off
+    arm), or to silence a hot path without if-guards at every site."""
+
+    def __init__(self):
+        super().__init__(event_log=None)
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name,
+                         lambda: _NullCounter(name, threading.Lock()))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name,
+                         lambda: _NullGauge(name, threading.Lock()))
+
+    def histogram(self, name: str,
+                  bounds: tuple[float, ...] | None = None) -> Histogram:
+        return self._get(
+            self._histograms, name,
+            lambda: _NullHistogram(name, threading.Lock(), bounds))
+
+    def event(self, kind: str, **fields: Any) -> None:
+        pass
+
+
+#: The shared process-local registry: the eager collectives engine,
+#: ``basics`` negotiation, and default-constructed ServeEngines all feed
+#: this one, so a single scrape sees training and serving together.
+DEFAULT = MetricsRegistry()
+
+#: The no-op twin (overhead measurement / explicit opt-out).
+NULL = NullRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Per-request tracing.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Trace:
+    """One request's span through the serving stack, surfaced on
+    ``RequestResult.trace``.  Timestamps are ``time.monotonic`` seconds
+    (comparable within the process; durations exact); ``*_step`` fields
+    are engine step indices.  ``None`` timestamp = the request never
+    reached that state (e.g. ``admit_ts`` stays None on a queue-side
+    REJECTED/TIMEOUT result)."""
+
+    rid: int
+    enqueue_ts: float
+    enqueue_step: int
+    admit_ts: float | None = None
+    admit_step: int | None = None
+    first_token_ts: float | None = None
+    terminal_ts: float | None = None
+    terminal_step: int | None = None
+    status: str | None = None
+    n_tokens: int = 0
+    prefill_chunks: int = 0
+    preemptions: int = 0
+    retries: int = 0
+    prefix_tokens_skipped: int = 0
+    queue_steps: int = 0
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        """Enqueue → first admission (None while queued)."""
+        if self.admit_ts is None:
+            return None
+        return self.admit_ts - self.enqueue_ts
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Enqueue → first emitted token (None if none was emitted)."""
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.enqueue_ts
+
+    @property
+    def e2e_s(self) -> float | None:
+        """Enqueue → terminal state (None while live)."""
+        if self.terminal_ts is None:
+            return None
+        return self.terminal_ts - self.enqueue_ts
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Time per output token after the first (decode cadence);
+        None until the request terminates with >= 2 tokens."""
+        if (self.terminal_ts is None or self.first_token_ts is None
+                or self.n_tokens < 2):
+            return None
+        return ((self.terminal_ts - self.first_token_ts)
+                / (self.n_tokens - 1))
+
+    def to_dict(self) -> dict:
+        """JSON-serializable form: every field plus the derived
+        latencies (the shape the event log and dashboards consume)."""
+        d = dataclasses.asdict(self)
+        d.update(queue_wait_s=self.queue_wait_s, ttft_s=self.ttft_s,
+                 e2e_s=self.e2e_s, tpot_s=self.tpot_s)
+        return d

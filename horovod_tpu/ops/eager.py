@@ -47,7 +47,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu import basics, timeline as timeline_mod
+from horovod_tpu import basics, metrics as metrics_mod
+from horovod_tpu import timeline as timeline_mod
 from horovod_tpu.basics import AXIS_NAME, HorovodInternalError
 from horovod_tpu.ops import collective_ops
 from horovod_tpu.ops.collective_ops import Average, Sum, _ReduceOp
@@ -395,11 +396,7 @@ class EagerEngine:
                 tune_sample = self._flush_via_controller(batch)
             elif batch:
                 for p in batch:
-                    if self.timeline:
-                        self.timeline.end(
-                            p.name,
-                            timeline_mod.NEGOTIATE + "_" + p.kind.upper(),
-                        )
+                    self._end_negotiate(p)
                 buckets = fusion.plan_buckets(
                     batch,
                     self.config.fusion_threshold_bytes,
@@ -628,6 +625,12 @@ class EagerEngine:
         return None
 
     def _end_negotiate(self, p: _PendingOp) -> None:
+        # Queue-time histogram: enqueue → the flush deciding to run the
+        # op, the same span the timeline's NEGOTIATE phase draws — but
+        # scrapeable with no timeline attached.
+        if p.enqueued_at:
+            metrics_mod.DEFAULT.histogram("hvd.negotiate_s").observe(
+                time.monotonic() - p.enqueued_at)
         if self.timeline:
             self.timeline.end(
                 p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
@@ -828,6 +831,7 @@ class EagerEngine:
             if len(group) > 1:
                 self.stats["tensors_fused"] += len(group)
             self.stats["allreduce_bytes"] += nbytes
+            metrics_mod.DEFAULT.counter("hvd.allreduce_bytes").inc(nbytes)
             return outs[-1], nbytes
         except Exception as e:
             for p in group:

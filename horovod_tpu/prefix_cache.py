@@ -54,6 +54,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
+from horovod_tpu import metrics as metrics_mod
 from horovod_tpu.models.llama import BlockPool
 
 
@@ -84,19 +85,29 @@ class RadixPrefixCache:
     ``stats``: cumulative counters — ``hits`` (acquire calls matching
     >= 1 block), ``misses``, ``blocks_reused``, ``tokens_skipped``
     (``blocks_reused * block_size``: prefill positions admission did
-    not recompute), ``inserted_blocks``, ``evicted_blocks``.
+    not recompute), ``inserted_blocks``, ``evicted_blocks``.  Each is
+    mirrored into ``metrics`` as a ``prefix.<name>`` counter
+    (:mod:`horovod_tpu.metrics`); the default ``NULL`` registry makes a
+    standalone cache silent, while :class:`ServeEngine` passes its own
+    registry so the mirrors land in the engine's scrape.
     """
 
-    def __init__(self, pool: BlockPool, block_size: int):
+    def __init__(self, pool: BlockPool, block_size: int,
+                 metrics: "metrics_mod.MetricsRegistry | None" = None):
         if block_size < 1:
             raise ValueError(f"block_size {block_size} must be >= 1")
         self.pool = pool
         self.block_size = block_size
+        self.metrics = metrics if metrics is not None else metrics_mod.NULL
         self._root = RadixNode(block=0, key=(), parent=None)
         self._nodes: dict[int, RadixNode] = {}     # block -> node
         self.stats = {"hits": 0, "misses": 0, "blocks_reused": 0,
                       "tokens_skipped": 0, "inserted_blocks": 0,
                       "evicted_blocks": 0}
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self.stats[key] += n
+        self.metrics.counter("prefix." + key).inc(n)
 
     # -- introspection -----------------------------------------------------
 
@@ -139,11 +150,11 @@ class RadixPrefixCache:
         for b in blocks:
             self.pool.incref(b)
         if blocks:
-            self.stats["hits"] += 1
-            self.stats["blocks_reused"] += len(blocks)
-            self.stats["tokens_skipped"] += len(blocks) * self.block_size
+            self._bump("hits")
+            self._bump("blocks_reused", len(blocks))
+            self._bump("tokens_skipped", len(blocks) * self.block_size)
         else:
-            self.stats["misses"] += 1
+            self._bump("misses")
         return blocks
 
     def release(self, blocks: Iterable[int]) -> None:
@@ -180,7 +191,8 @@ class RadixPrefixCache:
                 self.pool.mark_indexed(blocks[i])
                 added += 1
             node = child
-        self.stats["inserted_blocks"] += added
+        if added:
+            self._bump("inserted_blocks", added)
         return added
 
     # -- eviction ----------------------------------------------------------
@@ -210,7 +222,10 @@ class RadixPrefixCache:
                     break
             if not progress:
                 break
-        self.stats["evicted_blocks"] += freed
+        if freed:
+            self._bump("evicted_blocks", freed)
+            self.metrics.event("prefix.evict", freed=freed,
+                               indexed=len(self._nodes))
         return freed
 
     # -- debugging ---------------------------------------------------------

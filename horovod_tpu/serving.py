@@ -104,13 +104,21 @@ class RequestResult(list):
     ``error`` (the exception that condemned the request).  Non-``OK``
     results carry tokens-so-far: everything emitted before the request
     terminated (greedy determinism makes that a prefix of the solo run).
+
+    ``trace`` is the request's :class:`horovod_tpu.metrics.Trace` —
+    enqueue/admit/first-token/terminal timestamps plus prefill-chunk /
+    preemption / retry / prefix-reuse odometers.  The ServeEngine
+    populates it for EVERY terminal state (a rejected request still has
+    its enqueue and terminal stamps); simpler producers leave it None.
     """
 
     def __init__(self, tokens=(), status: str = OK,
-                 error: BaseException | None = None):
+                 error: BaseException | None = None,
+                 trace: Any = None):
         super().__init__(tokens)
         self.status = status
         self.error = error
+        self.trace = trace
 
     @property
     def tokens(self) -> list[int]:

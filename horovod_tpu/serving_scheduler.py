@@ -92,6 +92,7 @@ The engine is greedy-only; sampling pools stay on
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from functools import partial
@@ -102,6 +103,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu import faults as faults_mod
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import RadixPrefixCache
 from horovod_tpu.serving import (
@@ -201,6 +204,20 @@ class ServeEngine:
     ``serve.cache`` sites (defaults to the shared registry, which is a
     no-op unless armed).
 
+    ``metrics``: a :class:`horovod_tpu.metrics.MetricsRegistry` fed on
+    every step — TTFT / TPOT / queue-wait / e2e latency histograms
+    (``serve.*_s``), lifecycle counters mirroring ``self.counters``,
+    and KV-pool + prefix-cache gauges — plus one structured event per
+    request state transition when the registry has an event log
+    (``HVD_TPU_EVENT_LOG``).  Defaults to the process-shared
+    :data:`horovod_tpu.metrics.DEFAULT` registry (one scrape sees
+    training and serving together); pass
+    :data:`horovod_tpu.metrics.NULL` to opt out.  Every request also
+    carries a :class:`~horovod_tpu.metrics.Trace` (surfaced on
+    ``RequestResult.trace`` and mirrored into the timeline as a
+    per-rid ``REQ`` async span) regardless of the registry.
+    ``metrics_snapshot()`` returns the registry's plain-dict snapshot.
+
     ``prefix_cache``: enable transparent shared-prefix KV reuse
     (:mod:`horovod_tpu.prefix_cache`) — admission longest-prefix-matches
     each prompt against the radix index of previously served requests
@@ -224,6 +241,7 @@ class ServeEngine:
                  max_retries: int = 2,
                  watchdog_steps: int = 256,
                  faults: "faults_mod.FaultRegistry | None" = None,
+                 metrics: "metrics_mod.MetricsRegistry | None" = None,
                  prefix_cache: bool = False):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
@@ -244,6 +262,13 @@ class ServeEngine:
         self.max_retries = max_retries
         self.watchdog_steps = watchdog_steps
         self.faults = faults if faults is not None else faults_mod.DEFAULT
+        self.metrics = metrics if metrics is not None else metrics_mod.DEFAULT
+        # Register the latency histograms up front so metrics_snapshot()
+        # is schema-stable from step 0 (empty histograms report zeros).
+        for h in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
+                  "serve.e2e_s"):
+            self.metrics.histogram(h)
+        self._t0 = time.monotonic()
         self.pcache = llama.init_paged_cache(
             cfg, n_slots, max_len, block_size=block_size,
             n_blocks=n_blocks)
@@ -255,7 +280,8 @@ class ServeEngine:
         # legacy alias: the SAME list object the pool allocates from
         # (white-box tests drain it to force block starvation)
         self._free_blocks = self.pool._free
-        self.prefix = (RadixPrefixCache(self.pool, block_size)
+        self.prefix = (RadixPrefixCache(self.pool, block_size,
+                                        metrics=self.metrics)
                        if prefix_cache else None)
         self.prefix_counters = {"hits": 0, "blocks_reused": 0,
                                 "tokens_skipped": 0, "evictions": 0}
@@ -272,6 +298,7 @@ class ServeEngine:
         self._idle_steps = 0
         self._finished: dict[int, RequestResult] = {}
         self.results: dict[int, RequestResult] = {}
+        self.traces: dict[int, Trace] = {}
         self.events: list[SchedulerEvent] = []
         self.counters = {"preemptions": 0, "timeouts": 0,
                          "cancellations": 0, "rejections": 0,
@@ -341,13 +368,35 @@ class ServeEngine:
         return bool(self._queue) or any(
             s.state != FREE for s in self._slots)
 
+    def metrics_snapshot(self) -> dict:
+        """Plain-dict snapshot of the engine's registry: counters,
+        gauges, and the TTFT / TPOT / queue-wait / e2e histograms with
+        p50/p90/p99 — queryable with no timeline attached."""
+        return self.metrics.snapshot()
+
     def state_dump(self) -> str:
-        """Human-readable scheduler state (the watchdog's evidence)."""
+        """Human-readable scheduler state (the watchdog's evidence):
+        uptime / step totals, per-state slot and terminal-status
+        counts, pool and prefix-cache pictures, every queued and live
+        request, and the metrics snapshot — a full postmortem."""
+        states = {FREE: 0, PREFILL: 0, DECODE: 0}
+        for s in self._slots:
+            states[s.state] += 1
+        by_status: dict[str, int] = {}
+        for r in self.results.values():
+            by_status[r.status] = by_status.get(r.status, 0) + 1
         lines = [
-            f"step={self.step_index} queue_depth={len(self._queue)} "
+            f"step={self.step_index} uptime_s="
+            f"{time.monotonic() - self._t0:.3f} "
+            f"queue_depth={len(self._queue)} "
             f"free_blocks={len(self._free_blocks)}/"
             f"{self.pcache.k.shape[1] - 1} starve_steps="
             f"{self._starve_steps} counters={self.counters}",
+            f"  slots: free={states[FREE]} prefill={states[PREFILL]} "
+            f"decode={states[DECODE]}; submitted={self._next_id} "
+            f"finished={dict(sorted(by_status.items()))}",
+            "  metrics=" + json.dumps(self.metrics_snapshot(),
+                                      sort_keys=True),
         ]
         lines += ["  " + ln for ln in self.pool.state_lines()]
         if self.prefix is not None:
@@ -413,10 +462,18 @@ class ServeEngine:
                 f"has {self.pcache.k.shape[1] - 1} allocatable")
         rid = self._next_id
         self._next_id += 1
-        deadline = (None if req.deadline_s is None
-                    else time.monotonic() + req.deadline_s)
+        now = time.monotonic()
+        deadline = None if req.deadline_s is None else now + req.deadline_s
         self._queue.append(_QueueEntry(rid=rid, req=req,
                                        deadline=deadline))
+        self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
+                                 enqueue_step=self.step_index)
+        self.metrics.counter("serve.requests_submitted").inc()
+        self.metrics.event("serve.submit", rid=rid, step=self.step_index,
+                           prompt_len=L,
+                           max_new_tokens=req.max_new_tokens)
+        if self.timeline is not None:
+            self.timeline.async_start("serving.requests", "REQ", rid)
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -486,6 +543,14 @@ class ServeEngine:
         s.deadline = e.deadline
         s.admit_seq = self._admit_seq
         self._admit_seq += 1
+        tr = self.traces.get(e.rid)
+        if tr is not None:
+            if tr.admit_ts is None:       # first admission only: replay
+                tr.admit_ts = time.monotonic()   # re-admits don't re-queue
+                tr.admit_step = self.step_index
+                self.metrics.histogram("serve.queue_wait_s").observe(
+                    tr.admit_ts - tr.enqueue_ts)
+            tr.prefix_tokens_skipped += base
         self._event("admit", slot, e.rid)
         if hit:
             self.prefix_counters["hits"] += 1
@@ -535,7 +600,7 @@ class ServeEngine:
                     else:
                         e.retries += 1
                         e.wait_steps = 2 ** e.retries
-                        self.counters["retries"] += 1
+                        self._bump_counter("retries")
                         self._event("retry", -1, e.rid)
                         i += 1
                     continue
@@ -559,7 +624,7 @@ class ServeEngine:
                 else:
                     e.retries += 1
                     e.wait_steps = 2 ** e.retries
-                    self.counters["retries"] += 1
+                    self._bump_counter("retries")
                     self._event("retry", -1, e.rid)
                     i += 1
                 continue
@@ -633,7 +698,7 @@ class ServeEngine:
                 break
             slot = max(cands)[1]
             self._event("preempt", slot, self._slots[slot].request_id)
-            self.counters["preemptions"] += 1
+            self._bump_counter("preemptions")
             self._requeue(slot, retried=False)
             preempted += 1
         return preempted
@@ -648,6 +713,7 @@ class ServeEngine:
         res = RequestResult(list(s.prior) + list(s.out), status, error)
         self.results[s.request_id] = res
         self._finished[s.request_id] = res
+        self._finalize_trace(s.request_id, res)
         self._release_row_blocks(s, register=status == OK)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
@@ -666,6 +732,7 @@ class ServeEngine:
         res = RequestResult(list(e.prior), status, error)
         self.results[e.rid] = res
         self._finished[e.rid] = res
+        self._finalize_trace(e.rid, res)
         kind = {TIMEOUT: "timeout", CANCELLED: "cancel",
                 REJECTED: "reject", FAILED: "fail"}[status]
         self._event(kind, -1, e.rid)
@@ -675,7 +742,37 @@ class ServeEngine:
         key = {TIMEOUT: "timeouts", CANCELLED: "cancellations",
                REJECTED: "rejections", FAILED: "failures"}.get(status)
         if key is not None:
-            self.counters[key] += 1
+            self._bump_counter(key)
+
+    def _bump_counter(self, key: str) -> None:
+        """Advance a lifecycle counter in ``self.counters`` AND its
+        mirror in the metrics registry, so both always agree (the event
+        log's replay invariant is pinned against ``self.counters``)."""
+        self.counters[key] += 1
+        self.metrics.counter("serve." + key).inc()
+
+    def _finalize_trace(self, rid: int, res: RequestResult) -> None:
+        """Terminal bookkeeping for a request's :class:`Trace`: stamp the
+        end, attach it to the result (every terminal status — OK, TIMEOUT,
+        CANCELLED, REJECTED, FAILED — flows through here), and feed the
+        end-to-end latency histograms."""
+        tr = self.traces.pop(rid, None)
+        if tr is None:
+            return
+        tr.terminal_ts = time.monotonic()
+        tr.terminal_step = self.step_index
+        tr.status = res.status
+        tr.n_tokens = len(res.tokens)
+        res.trace = tr
+        self.metrics.histogram("serve.e2e_s").observe(tr.e2e_s)
+        tpot = tr.tpot_s
+        if tpot is not None:
+            self.metrics.histogram("serve.tpot_s").observe(tpot)
+        self.metrics.counter("serve.requests_completed").inc()
+        if tr.n_tokens:
+            self.metrics.counter("serve.tokens_emitted").inc(tr.n_tokens)
+        if self.timeline is not None:
+            self.timeline.async_end("serving.requests", "REQ", rid)
 
     def _slot_fault(self, slot: int, exc: BaseException) -> None:
         """Quarantine a prefill-window fault to its own request:
@@ -689,7 +786,7 @@ class ServeEngine:
             return
         s.retries += 1
         s.wait_steps = 2 ** s.retries
-        self.counters["retries"] += 1
+        self._bump_counter("retries")
         self._event("retry", slot, s.request_id)
 
     def _row_fault(self, slot: int, exc: BaseException) -> None:
@@ -704,7 +801,7 @@ class ServeEngine:
                 or not self._replayable(s)):
             self._terminate(slot, FAILED, exc)
             return
-        self.counters["retries"] += 1
+        self._bump_counter("retries")
         self._event("retry", slot, s.request_id)
         self._requeue(slot, retried=True)
 
@@ -732,6 +829,17 @@ class ServeEngine:
     def _event(self, kind: str, slot: int, rid: int) -> None:
         self.events.append(
             SchedulerEvent(kind, self.step_index, slot, rid))
+        tr = self.traces.get(rid)
+        if tr is not None:
+            if kind == "retry":
+                tr.retries += 1
+            elif kind == "preempt":
+                tr.preemptions += 1
+        # One structured-log line per scheduler event: counter bumps are
+        # 1:1 with _event() calls, so replaying the JSONL reproduces
+        # ``self.counters`` exactly (tested in test_metrics.py).
+        self.metrics.event("serve." + kind, rid=rid, slot=slot,
+                           step=self.step_index)
         if self.timeline is not None:
             self.timeline.instant("serving.scheduler", kind.upper())
 
@@ -818,6 +926,9 @@ class ServeEngine:
                 progress += 1
                 continue
             e.queued_steps += 1
+            tr = self.traces.get(e.rid)
+            if tr is not None:
+                tr.queue_steps += 1
             if e.wait_steps > 0:
                 e.wait_steps -= 1
                 progress += 1
@@ -866,6 +977,9 @@ class ServeEngine:
                 continue
             s.w_done += 1
             progress += 1
+            tr = self.traces.get(s.request_id)
+            if tr is not None:
+                tr.prefill_chunks += 1
             if final:
                 s.state = DECODE      # joins this step's tick
         decoding = [i for i, s in enumerate(self._slots)
@@ -897,6 +1011,12 @@ class ServeEngine:
                     except Exception as exc:
                         self._row_fault(slot, exc)
                         continue
+                    if not s.prior and not s.out:
+                        tr = self.traces.get(s.request_id)
+                        if tr is not None and tr.first_token_ts is None:
+                            tr.first_token_ts = time.monotonic()
+                            self.metrics.histogram(
+                                "serve.ttft_s").observe(tr.ttft_s)
                     s.out.append(t)
                     s.budget -= 1
                     if s.budget <= 0 or t == s.eos:
@@ -915,6 +1035,20 @@ class ServeEngine:
                 self.timeline.counter(
                     "serving.scheduler", "PREFIX",
                     dict(self.prefix_counters))
+        # Registry mirror of the SCHED track: occupancy gauges sampled
+        # once per step, plus the step odometer — available with no
+        # timeline attached (the scrape path).
+        self.metrics.counter("serve.steps").inc()
+        self.metrics.gauge("serve.queue_depth").set(len(self._queue))
+        self.metrics.gauge("serve.decoding").set(len(decoding))
+        self.metrics.gauge("serve.prefilling").set(
+            sum(1 for s in self._slots if s.state == PREFILL))
+        self.metrics.gauge("serve.free_blocks").set(len(self._free_blocks))
+        self.metrics.gauge("serve.cached_blocks").set(
+            self.pool.cached_count())
+        if self.prefix is not None:
+            self.metrics.gauge("serve.prefix_indexed_blocks").set(
+                self.prefix.indexed_blocks())
         if self._verify_blocks:
             self._check_block_invariants()
         if self.pending() and progress == 0:
@@ -962,27 +1096,45 @@ def measure_throughput(
     timing; only true emitted tokens count, for both.  Returns
     ``serve_tokens_per_sec``, ``static_tokens_per_sec``,
     ``serve_vs_static_ratio``, ``preemptions`` (timed pass only; nonzero
-    only with ``preempt_after`` on an overcommitted ``n_blocks`` pool)
-    and workload shape fields.
+    only with ``preempt_after`` on an overcommitted ``n_blocks`` pool),
+    latency percentiles from the metrics-on pass
+    (``serve_ttft_p50_ms`` .. ``serve_e2e_p99_ms``),
+    ``serve_metrics_overhead_pct`` (instrumented vs null-registry pass —
+    the acceptance bound for the observability layer is < 2 %) and
+    workload shape fields.
     """
     if not requests:
         raise ValueError("empty workload")
 
     eng = ServeEngine(params, cfg, n_slots=n_slots, max_len=max_len,
                       chunk=chunk, block_size=block_size,
-                      n_blocks=n_blocks, preempt_after=preempt_after)
+                      n_blocks=n_blocks, preempt_after=preempt_after,
+                      metrics=metrics_mod.NULL)
     warm = eng.run(requests)                 # compiles every program
     assert all(r.ok for r in warm), [r.status for r in warm]
     n_tokens = sum(len(t) for t in warm)
     # timed pass reuses the SAME engine (its jit programs are
     # per-instance): after run() every slot is free, so the pool is in
-    # its admission-ready state again
+    # its admission-ready state again.  Metrics ON is the shipping
+    # configuration, so it is the primary number; a second pass with
+    # the null registry prices the instrumentation itself.
+    reg = metrics_mod.MetricsRegistry(event_log=None)
+    eng.metrics = reg
     preempt0 = eng.counters["preemptions"]
     t0 = time.perf_counter()
     out = eng.run(requests)
     jax.block_until_ready(eng.pcache.k)
     t_serve = time.perf_counter() - t0
     assert [len(t) for t in out] == [len(t) for t in warm]
+    eng.metrics = metrics_mod.NULL
+    t0 = time.perf_counter()
+    off = eng.run(requests)
+    jax.block_until_ready(eng.pcache.k)
+    t_serve_off = time.perf_counter() - t0
+    assert [len(t) for t in off] == [len(t) for t in warm]
+    hist = {name: reg.histogram(name)
+            for name in ("serve.ttft_s", "serve.tpot_s",
+                         "serve.queue_wait_s", "serve.e2e_s")}
 
     # static baseline: batches of n_slots, one compiled generate per
     # distinct batch budget (compiles excluded by per-batch warmup)
@@ -1019,6 +1171,14 @@ def measure_throughput(
         "static_tokens_per_sec": n_tokens / t_static,
         "serve_vs_static_ratio": t_static / t_serve,
         "preemptions": eng.counters["preemptions"] - preempt0,
+        "serve_ttft_p50_ms": hist["serve.ttft_s"].percentile(0.5) * 1e3,
+        "serve_ttft_p99_ms": hist["serve.ttft_s"].percentile(0.99) * 1e3,
+        "serve_tpot_p50_ms": hist["serve.tpot_s"].percentile(0.5) * 1e3,
+        "serve_queue_wait_p99_ms":
+            hist["serve.queue_wait_s"].percentile(0.99) * 1e3,
+        "serve_e2e_p99_ms": hist["serve.e2e_s"].percentile(0.99) * 1e3,
+        "serve_metrics_overhead_pct":
+            (t_serve - t_serve_off) / t_serve_off * 100.0,
         "tokens": n_tokens,
         "n_requests": len(requests),
         "n_slots": n_slots,

@@ -119,13 +119,18 @@ class Timeline:
             )
 
     def instant(self, tensor_name: str, activity: str) -> None:
-        """Negotiation-tick instant event (reference timeline.cc:118-126)."""
+        """Negotiation-tick / scheduler-event instant (reference
+        timeline.cc:118-126).  Emitted as a true Chrome instant event —
+        ``ph: "i"`` with thread scope — not the zero-width complete
+        event (``ph: "X", dur: 0``) earlier versions wrote, which
+        chrome://tracing renders as an invisible sliver instead of the
+        instant marker."""
         with self._lock:
             if self._closed:
                 return
             self._emit(
-                {"name": activity, "ph": "X", "ts": self._ts_us(), "dur": 0,
-                 "pid": self._pid(tensor_name), "tid": 0}
+                {"name": activity, "ph": "i", "ts": self._ts_us(),
+                 "pid": self._pid(tensor_name), "tid": 0, "s": "t"}
             )
 
     def counter(self, tensor_name: str, activity: str,

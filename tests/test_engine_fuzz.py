@@ -153,9 +153,10 @@ def test_engine_pipelined_dispatch_native_controller(monkeypatch):
 
 
 @pytest.mark.faults
+@pytest.mark.metrics
 @pytest.mark.parametrize("prefix_cache", [False, True])
 @pytest.mark.parametrize("seed", [3, 17])
-def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
+def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, tmp_path):
     """Randomized request lifecycle sweep of the ServeEngine under an
     overcommitted KV pool: seeded random prompts/budgets, one hard
     deadline, one permanently poisoned request, transient injected
@@ -167,10 +168,18 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
     and the non-OK statuses land exactly where the schedule says.
     Runs with the shared-prefix cache both off (classic free-list
     accounting) and on (release-to-cache: the same sweep must drain to
-    a consistent radix index with zero live references)."""
+    a consistent radix index with zero live references).
+
+    The observability layer rides the same sweep: the registry's
+    lifecycle counters must grow monotonically step over step, every
+    terminal result must carry a finalized trace, and replaying the
+    JSONL event log must reproduce ``eng.counters`` exactly."""
     import jax
 
     from horovod_tpu.faults import FaultRegistry
+    from horovod_tpu.metrics import (
+        LIFECYCLE_EVENT_COUNTERS, EventLog, MetricsRegistry,
+    )
     from horovod_tpu.models import llama
     from horovod_tpu.serving import (
         CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request,
@@ -200,9 +209,11 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
     # Overcommitted pool: full backing would be 2*6+1 = 13 blocks; 9
     # forces admission stalls and preemption-with-replay churn.
     reg = FaultRegistry()
+    log_path = str(tmp_path / f"events_{seed}_{prefix_cache}.jsonl")
+    mreg = MetricsRegistry(event_log=EventLog(log_path))
     eng = ServeEngine(params, cfg, n_slots=2, max_len=max_len, chunk=4,
                       block_size=4, n_blocks=9, preempt_after=2,
-                      faults=reg, prefix_cache=prefix_cache)
+                      faults=reg, prefix_cache=prefix_cache, metrics=mreg)
     ids = [eng.submit(r) for r in reqs]
     reg.inject("serve.tick", on_hit=2, permanent=True, key=ids[perm])
     reg.inject("serve.admit", on_hit=1, key=ids[tr_admit])
@@ -210,6 +221,8 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
     cancel_at = {ids[c0]: int(rng.integers(1, 4)),
                  ids[c1]: int(rng.integers(4, 9))}
 
+    lifecycle = sorted(eng.counters)
+    prev = {k: 0 for k in lifecycle}
     step = 0
     while eng.pending() and step < 400:
         for rid, at in cancel_at.items():
@@ -217,7 +230,28 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache):
                 eng.cancel(rid)
         eng.step()
         step += 1
+        # counter monotonicity, sampled every step of the churn: the
+        # registry mirrors only ever move up, in lockstep with the
+        # engine's own dict
+        for k in lifecycle:
+            v = mreg.counter("serve." + k).value
+            assert v >= prev[k], f"seed={seed} counter serve.{k} went down"
+            assert v == eng.counters[k]
+            prev[k] = v
     assert not eng.pending(), f"fuzz seed={seed} did not drain"
+    # event-log replay reproduces the lifecycle counters exactly, and
+    # every terminal result carries a finalized trace
+    replayed = {k: 0 for k in lifecycle}
+    for ev in EventLog.read(log_path):
+        key = LIFECYCLE_EVENT_COUNTERS.get(ev["kind"])
+        if key is not None:
+            replayed[key] += 1
+    assert replayed == dict(eng.counters), f"seed={seed} replay diverged"
+    for rid in ids:
+        res = eng.results[rid]
+        assert res.trace is not None and res.trace.status == res.status
+        assert res.trace.n_tokens == len(list(res))
+    assert eng.traces == {}
 
     allowed = {ids[i]: {OK} for i in range(8)}
     allowed[ids[dl]] = {TIMEOUT}

@@ -142,7 +142,7 @@ def test_timeline_negotiate_ticks_single_controller(tmp_path, monkeypatch):
     names = [e["name"] for e in events]
     assert "NEGOTIATE_ALLREDUCE" in names
     ticks = [e for e in events if e["name"] == "NEGOTIATE_TICK_ALL"]
-    assert ticks and all(e["ph"] == "X" for e in ticks)
+    assert ticks and all(e["ph"] == "i" and e["s"] == "t" for e in ticks)
 
 
 def test_timeline_negotiate_ticks_native_controller(tmp_path, monkeypatch):

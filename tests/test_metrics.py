@@ -1,0 +1,516 @@
+"""Observability layer (horovod_tpu/metrics.py) + its serving threading.
+
+Three layers of pinning:
+
+1. *Instrument math*: fixed-log-bucket histograms (bounds, percentile
+   interpolation, min/max clamping), counter monotonicity, the
+   schema-stable ``snapshot()`` dict and the Prometheus text
+   exposition (all units are SI seconds; `_ms` conversion is the
+   consumer's job).
+2. *Event-log ground truth*: the JSONL sink round-trips (torn final
+   line tolerated), and replaying a serve run's lines by
+   ``LIFECYCLE_EVENT_COUNTERS`` reproduces the engine's lifecycle
+   counters exactly — the structural 1:1 of counter bumps with
+   ``_event()`` emissions.
+3. *Per-request traces*: ``RequestResult.trace`` is populated for EVERY
+   terminal state (OK / TIMEOUT / CANCELLED / REJECTED / FAILED,
+   including preempted-replayed and quarantined requests), its stamps
+   are ordered, and the engine's TTFT/TPOT/queue-wait/e2e histograms
+   fill with no timeline attached.
+
+``tools/check_counter_names.py`` runs as a test here, so an
+unregistered counter series or fault site fails the suite.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.faults import FaultRegistry
+from horovod_tpu.metrics import (
+    Counter, EventLog, Histogram, MetricsRegistry, NullRegistry, Trace,
+    log_bucket_bounds,
+)
+from horovod_tpu.models import llama
+from horovod_tpu.serving import (
+    CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request,
+)
+from horovod_tpu.serving_scheduler import ServeEngine
+
+pytestmark = pytest.mark.metrics
+
+
+# ---------------------------------------------------------------------------
+# Instrument math.
+# ---------------------------------------------------------------------------
+
+
+def test_log_bucket_bounds_default():
+    b = log_bucket_bounds()
+    assert len(b) == 28                      # 9 decades * 3 + 1
+    assert list(b) == sorted(b)
+    assert b[0] == pytest.approx(1e-6)
+    assert b[-1] == pytest.approx(1e3)
+    # each decade spans exactly 3 buckets
+    assert b[3] / b[0] == pytest.approx(10.0)
+    with pytest.raises(ValueError):
+        log_bucket_bounds(lo=1.0, hi=0.5)
+
+
+def test_counter_monotone_and_negative_rejected():
+    import threading
+
+    c = Counter("c", threading.Lock())
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 6
+
+
+def test_histogram_single_sample_exact():
+    """min/max clamping: a single observation reports its true value,
+    not a bucket edge, at every quantile."""
+    import threading
+
+    h = Histogram("h", threading.Lock())
+    h.observe(0.0123)
+    for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert h.percentile(q) == pytest.approx(0.0123)
+    snap = h.snapshot()
+    assert snap["count"] == 1 and snap["min"] == snap["max"] == 0.0123
+
+
+def test_histogram_percentile_bucket_resolution():
+    """Uniform samples over [1, 2] s: every quantile estimate must land
+    within the bucket's <= 10^(1/3) relative error bound."""
+    import threading
+
+    h = Histogram("h", threading.Lock())
+    vals = np.linspace(1.0, 2.0, 101)
+    for v in vals:
+        h.observe(float(v))
+    for q in (0.10, 0.50, 0.90, 0.99):
+        true = float(np.quantile(vals, q))
+        est = h.percentile(q)
+        assert true / 2.16 <= est <= true * 2.16, (q, est, true)
+    assert h.count == 101
+    assert h.sum == pytest.approx(vals.sum())
+    # above-range values land in the overflow bucket, clamped to max
+    h.observe(5e4)
+    assert h.percentile(1.0) == pytest.approx(5e4)
+
+
+def test_histogram_empty_and_bad_args():
+    import threading
+
+    h = Histogram("h", threading.Lock())
+    assert h.percentile(0.5) == 0.0
+    assert h.snapshot() == {"count": 0, "sum": 0.0, "min": 0.0,
+                            "max": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+    with pytest.raises(ValueError):
+        Histogram("h", threading.Lock(), bounds=(2.0, 1.0))
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    reg = MetricsRegistry(event_log=None)
+    assert reg.counter("a") is reg.counter("a")
+    assert reg.histogram("h") is reg.histogram("h")
+    with pytest.raises(ValueError):
+        reg.gauge("a")                     # 'a' is already a Counter
+    with pytest.raises(ValueError):
+        reg.counter("h")
+
+
+def test_snapshot_schema_stable():
+    """The documented shape: counters/gauges/histograms at the top,
+    count/sum/min/max/p50/p90/p99 per histogram — and nothing else
+    (dashboards key on these names)."""
+    reg = MetricsRegistry(event_log=None)
+    reg.counter("serve.steps").inc(3)
+    reg.gauge("serve.queue_depth").set(2)
+    reg.histogram("serve.ttft_s").observe(0.05)
+    snap = reg.snapshot()
+    assert set(snap) == {"counters", "gauges", "histograms"}
+    assert snap["counters"] == {"serve.steps": 3}
+    assert snap["gauges"] == {"serve.queue_depth": 2.0}
+    assert set(snap["histograms"]["serve.ttft_s"]) == {
+        "count", "sum", "min", "max", "p50", "p90", "p99"}
+    json.dumps(snap)                       # JSON-serializable end to end
+
+
+def test_prometheus_exposition():
+    reg = MetricsRegistry(event_log=None)
+    reg.counter("serve.steps").inc(7)
+    reg.gauge("kv.free_blocks").set(12)
+    h = reg.histogram("serve.ttft_s", bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    assert "# TYPE serve_steps counter\nserve_steps 7" in text
+    assert "# TYPE kv_free_blocks gauge\nkv_free_blocks 12" in text
+    # cumulative buckets: 1 below 0.1, 2 below 1.0, 3 total
+    assert 'serve_ttft_s_bucket{le="0.1"} 1' in text
+    assert 'serve_ttft_s_bucket{le="1"} 2' in text
+    assert 'serve_ttft_s_bucket{le="+Inf"} 3' in text
+    assert "serve_ttft_s_count 3" in text
+    assert text.endswith("\n")
+
+
+def test_null_registry_discards_everything():
+    null = NullRegistry()
+    null.counter("x").inc(10)
+    null.gauge("y").set(5)
+    null.histogram("z").observe(1.0)
+    null.event("anything", rid=1)
+    snap = null.snapshot()
+    assert snap["counters"]["x"] == 0
+    assert snap["gauges"]["y"] == 0.0
+    assert snap["histograms"]["z"]["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Event log.
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_round_trip_and_torn_line(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path)
+    log.emit("serve.submit", rid=0, step=0)
+    log.emit("fault", site="serve.tick", key=3, hit=2, permanent=True)
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"ts": 1.0, "kind": "serve.adm')   # writer died mid-line
+    events = EventLog.read(path)
+    assert [e["kind"] for e in events] == ["serve.submit", "fault"]
+    assert events[0]["rid"] == 0 and "ts" in events[0]
+    assert events[1]["site"] == "serve.tick"
+    log.emit("after.close")                # silently dropped, not fatal
+    assert len(EventLog.read(path)) == 2
+
+
+def test_env_event_log_is_singleton_per_path(tmp_path, monkeypatch):
+    """Two registries resolving ``event_log="auto"`` against the same
+    ``HVD_TPU_EVENT_LOG`` share ONE EventLog (one lock, one append
+    stream), and emits from both land in the same file."""
+    path = str(tmp_path / "shared.jsonl")
+    monkeypatch.setenv("HVD_TPU_EVENT_LOG", path)
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.event("from.a", n=1)
+    b.event("from.b", n=2)
+    assert metrics_mod.env_event_log() is metrics_mod.env_event_log()
+    kinds = [e["kind"] for e in EventLog.read(path)]
+    assert kinds == ["from.a", "from.b"]
+    monkeypatch.delenv("HVD_TPU_EVENT_LOG")
+    a.event("unsunk")                      # env off -> no sink, no error
+    assert len(EventLog.read(path)) == 2
+
+
+# ---------------------------------------------------------------------------
+# Trace math.
+# ---------------------------------------------------------------------------
+
+
+def test_trace_derived_latencies():
+    tr = Trace(rid=1, enqueue_ts=10.0, enqueue_step=0)
+    assert tr.queue_wait_s is None and tr.ttft_s is None
+    assert tr.e2e_s is None and tr.tpot_s is None
+    tr.admit_ts, tr.first_token_ts, tr.terminal_ts = 10.5, 11.0, 13.0
+    tr.n_tokens = 5
+    assert tr.queue_wait_s == pytest.approx(0.5)
+    assert tr.ttft_s == pytest.approx(1.0)
+    assert tr.e2e_s == pytest.approx(3.0)
+    assert tr.tpot_s == pytest.approx(2.0 / 4)
+    tr.n_tokens = 1
+    assert tr.tpot_s is None               # needs a decode cadence
+    d = tr.to_dict()
+    assert d["rid"] == 1 and d["ttft_s"] == pytest.approx(1.0)
+    json.dumps(d)
+
+
+# ---------------------------------------------------------------------------
+# Serving integration.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _reqs(n=4, pl=3, new=4):
+    rng = np.random.default_rng(2)
+    return [Request(prompt=[int(t) for t in
+                            rng.integers(1, 250, pl + (i % 3))],
+                    max_new_tokens=new)
+            for i in range(n)]
+
+
+def test_engine_metrics_snapshot_no_timeline(world):
+    """The headline acceptance: latency percentiles are queryable from
+    ``metrics_snapshot()`` on a plain engine — no timeline attached."""
+    cfg, params = world
+    reg = MetricsRegistry(event_log=None)
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      metrics=reg)
+    assert eng.timeline is None
+    out = eng.run(_reqs())
+    assert all(r.ok for r in out)
+    snap = eng.metrics_snapshot()
+    for name in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
+                 "serve.e2e_s"):
+        h = snap["histograms"][name]
+        assert h["count"] >= 1, name
+        assert 0.0 <= h["p50"] <= h["p99"], name
+    assert snap["counters"]["serve.requests_submitted"] == 4
+    assert snap["counters"]["serve.requests_completed"] == 4
+    assert snap["counters"]["serve.tokens_emitted"] == sum(
+        len(r) for r in out)
+    assert snap["counters"]["serve.steps"] == eng.step_index
+    assert snap["gauges"]["serve.queue_depth"] == 0.0
+
+
+def test_engine_metrics_snapshot_schema_before_first_step(world):
+    """The latency histograms are registered at construction, so a
+    scrape between engine creation and the first step sees the full
+    schema (zeros), not missing keys."""
+    cfg, params = world
+    reg = MetricsRegistry(event_log=None)
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      metrics=reg)
+    snap = eng.metrics_snapshot()
+    for name in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
+                 "serve.e2e_s"):
+        assert snap["histograms"][name]["count"] == 0
+
+
+def test_ok_trace_fields(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      metrics=MetricsRegistry(event_log=None))
+    reqs = _reqs()
+    out = eng.run(reqs)
+    for r in out:
+        tr = r.trace
+        assert tr is not None and tr.status == OK
+        assert tr.n_tokens == len(r)
+        assert tr.enqueue_ts <= tr.admit_ts <= tr.first_token_ts \
+            <= tr.terminal_ts
+        assert tr.enqueue_step <= tr.admit_step <= tr.terminal_step
+        assert tr.prefill_chunks >= 1
+        assert tr.preemptions == 0 and tr.retries == 0
+        assert tr.ttft_s >= tr.queue_wait_s >= 0.0
+        assert tr.e2e_s >= tr.ttft_s
+    # the traces table drains with the requests
+    assert eng.traces == {}
+
+
+def test_trace_every_terminal_state(world):
+    """One request per terminal state — including preempted-replayed
+    (OK after preemption) and quarantined (FAILED on a permanent
+    fault) — and every result carries a finalized trace."""
+    cfg, params = world
+    freg = FaultRegistry()
+    # overcommitted pool forces queue pressure -> preemption + shed
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=24, chunk=4,
+                      block_size=4, n_blocks=9, preempt_after=2,
+                      faults=freg, metrics=MetricsRegistry(event_log=None))
+    reqs = [Request(prompt=[7, 8, 9], max_new_tokens=8),       # OK
+            Request(prompt=[5, 6], max_new_tokens=8),          # OK
+            Request(prompt=[1, 2, 3], max_new_tokens=4,
+                    deadline_s=0.0),                           # TIMEOUT
+            Request(prompt=[4, 4], max_new_tokens=4),          # CANCELLED
+            Request(prompt=[9, 9, 9], max_new_tokens=3),       # FAILED
+            Request(prompt=[2, 2], max_new_tokens=2,
+                    max_queue_steps=1)]                        # REJECTED
+    ids = [eng.submit(r) for r in reqs]
+    freg.inject("serve.tick", on_hit=1, permanent=True, key=ids[4])
+    eng.cancel(ids[3])
+    steps = 0
+    while eng.pending() and steps < 300:
+        eng.step()
+        steps += 1
+    assert not eng.pending()
+    want = {ids[0]: OK, ids[1]: OK, ids[2]: TIMEOUT,
+            ids[3]: CANCELLED, ids[4]: FAILED}
+    for rid, status in want.items():
+        res = eng.results[rid]
+        assert res.status == status
+        tr = res.trace
+        assert tr is not None and tr.rid == rid and tr.status == status
+        assert tr.terminal_ts is not None and tr.terminal_step is not None
+    # load-shed may race to OK depending on admission; both carry traces
+    shed = eng.results[ids[5]]
+    assert shed.status in (OK, REJECTED) and shed.trace is not None
+    if shed.status == REJECTED:
+        assert shed.trace.admit_ts is None     # never entered a slot
+    # quarantined request: terminal trace despite the poisoned row
+    assert eng.results[ids[4]].trace.n_tokens == len(eng.results[ids[4]])
+    assert eng.traces == {}
+
+
+def test_event_log_replays_to_engine_counters(world, tmp_path):
+    """THE acceptance invariant: counting the JSONL's lifecycle kinds
+    (LIFECYCLE_EVENT_COUNTERS) reproduces ``eng.counters`` exactly —
+    under injected faults, preemption and cancels."""
+    cfg, params = world
+    path = str(tmp_path / "events.jsonl")
+    reg = MetricsRegistry(event_log=EventLog(path))
+    freg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=24, chunk=4,
+                      block_size=4, n_blocks=9, preempt_after=2,
+                      faults=freg, metrics=reg)
+    rng = np.random.default_rng(9)
+    reqs = [Request(prompt=[int(t) for t in rng.integers(1, 250, 3 + i % 4)],
+                    max_new_tokens=2 + i % 5) for i in range(7)]
+    reqs[2].deadline_s = 0.0
+    ids = [eng.submit(r) for r in reqs]
+    freg.inject("serve.prefill", on_hit=1, key=ids[1])        # transient
+    freg.inject("serve.tick", on_hit=2, permanent=True, key=ids[5])
+    eng.cancel(ids[6])
+    steps = 0
+    while eng.pending() and steps < 300:
+        eng.step()
+        steps += 1
+    assert not eng.pending()
+    replayed = {k: 0 for k in eng.counters}
+    for ev in EventLog.read(path):
+        key = metrics_mod.LIFECYCLE_EVENT_COUNTERS.get(ev["kind"])
+        if key is not None:
+            replayed[key] += 1
+    assert replayed == dict(eng.counters)
+    assert eng.counters["retries"] >= 1 and eng.counters["failures"] >= 1
+    # the registry's serve.* mirrors agree with both
+    snap = reg.snapshot()
+    for key, n in eng.counters.items():
+        assert snap["counters"].get("serve." + key, 0) == n
+    # submit lines carry the queue-side context dashboards join on
+    submits = [e for e in EventLog.read(path) if e["kind"] == "serve.submit"]
+    assert len(submits) == len(reqs)
+    assert all({"rid", "step", "prompt_len", "max_new_tokens", "ts"}
+               <= set(e) for e in submits)
+
+
+def test_fault_sites_mirror_into_default_registry(world, tmp_path,
+                                                  monkeypatch):
+    """faults.check() firings land in the DEFAULT registry (counter per
+    site) and in the env event log, stamped with site/key/hit."""
+    cfg, params = world
+    path = str(tmp_path / "faults.jsonl")
+    monkeypatch.setenv("HVD_TPU_EVENT_LOG", path)
+    before = metrics_mod.DEFAULT.counter("faults.fired.serve.tick").value
+    freg = FaultRegistry()
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4,
+                      faults=freg, metrics=MetricsRegistry(event_log=None))
+    rid = eng.submit(Request(prompt=[3, 4, 5], max_new_tokens=3))
+    freg.inject("serve.tick", on_hit=1, key=rid)
+    steps = 0
+    while eng.pending() and steps < 100:
+        eng.step()
+        steps += 1
+    assert eng.results[rid].status == OK          # transient: replayed
+    after = metrics_mod.DEFAULT.counter("faults.fired.serve.tick").value
+    assert after == before + 1
+    fault_events = [e for e in EventLog.read(path) if e["kind"] == "fault"]
+    assert len(fault_events) == 1
+    assert fault_events[0]["site"] == "serve.tick"
+    assert fault_events[0]["key"] == rid
+
+
+def test_request_timeline_async_spans(world, tmp_path):
+    """Each request is one ``REQ`` async span (ph b/e matched by rid)
+    on the serving.requests track, alongside the instant/counter
+    events the scheduler already wrote."""
+    from horovod_tpu.timeline import Timeline
+
+    cfg, params = world
+    tl = Timeline(str(tmp_path / "tl.json"))
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      timeline=tl, metrics=MetricsRegistry(event_log=None))
+    reqs = _reqs()
+    out = eng.run(reqs)
+    assert all(r.ok for r in out)
+    tl.close()
+    events = json.load(open(tmp_path / "tl.json"))
+    b = [e for e in events if e.get("ph") == "b" and e["name"] == "REQ"]
+    e_ = [e for e in events if e.get("ph") == "e" and e["name"] == "REQ"]
+    assert len(b) == len(e_) == len(reqs)
+    assert sorted(ev["id"] for ev in b) == sorted(ev["id"] for ev in e_)
+    assert all(ev["cat"] == "REQ" for ev in b + e_)
+
+
+def test_state_dump_enriched(world):
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      metrics=MetricsRegistry(event_log=None))
+    eng.run(_reqs(n=3))
+    dump = eng.state_dump()
+    assert "uptime_s=" in dump and f"step={eng.step_index}" in dump
+    assert "submitted=3" in dump and f"'{OK}': 3" in dump
+    assert "free=2 prefill=0 decode=0" in dump
+    m = json.loads(dump.split("metrics=", 1)[1].splitlines()[0])
+    assert m["counters"]["serve.requests_completed"] == 3
+
+
+def test_eager_collectives_feed_default_registry():
+    """Training and serving share one registry: an eager allreduce
+    lands bytes in ``hvd.allreduce_bytes`` and a queue-time sample in
+    the ``hvd.negotiate_s`` histogram."""
+    reg = metrics_mod.DEFAULT
+    bytes0 = reg.counter("hvd.allreduce_bytes").value
+    neg0 = reg.histogram("hvd.negotiate_s").count
+    n = hvd.size()
+    out = hvd.allreduce(jnp.ones((n, 4), jnp.float32), name="metrics.ar",
+                        average=False)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), n))
+    assert reg.counter("hvd.allreduce_bytes").value > bytes0
+    assert reg.histogram("hvd.negotiate_s").count > neg0
+
+
+def test_prefix_cache_mirrors(world):
+    cfg, params = world
+    reg = MetricsRegistry(event_log=None)
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=24, chunk=4,
+                      block_size=4, prefix_cache=True, metrics=reg)
+    shared = [11, 12, 13, 14, 15, 16, 17, 18]
+    reqs = [Request(prompt=shared + [30 + i], max_new_tokens=2)
+            for i in range(4)]
+    out = eng.run(reqs)
+    assert all(r.ok for r in out)
+    snap = reg.snapshot()
+    assert snap["counters"]["prefix.hits"] == eng.prefix.stats["hits"] > 0
+    assert (snap["counters"]["prefix.tokens_skipped"]
+            == eng.prefix.stats["tokens_skipped"] > 0)
+    assert snap["gauges"]["serve.prefix_indexed_blocks"] \
+        == eng.prefix.indexed_blocks()
+    # traces record the per-request prefill work actually skipped
+    assert sum(r.trace.prefix_tokens_skipped for r in out) \
+        == eng.prefix.stats["tokens_skipped"]
+
+
+def test_check_counter_names_lint():
+    """The canonical-table lint runs as part of the suite: every
+    timeline counter series and fault site in the code is registered in
+    metrics.py, and vice versa."""
+    spec = importlib.util.spec_from_file_location(
+        "check_counter_names",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "check_counter_names.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

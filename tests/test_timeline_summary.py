@@ -1,6 +1,7 @@
 """tools/timeline_summary.py against traces the Timeline actually emits."""
 
 import importlib.util
+import json
 import os
 
 import pytest
@@ -89,3 +90,87 @@ def test_unbalanced_counts_every_open_b(summary_mod):
     ]
     s = summary_mod.summarize(events)
     assert len(s["unbalanced"]) == 2
+
+
+def _make_serving_trace(tmp_path):
+    """A trace shaped like the serving scheduler's: per-step counter
+    series, lifecycle instants, and one REQ async span per request."""
+    from horovod_tpu.timeline import Timeline
+
+    path = tmp_path / "serve.json"
+    tl = Timeline(str(path))
+    for step in range(4):
+        tl.counter("serving.scheduler", "SCHED",
+                   {"queued": 3 - step, "free_blocks": 4 + step})
+        tl.counter("serving.scheduler", "LIFECYCLE",
+                   {"preemptions": step // 2, "retries": 0})
+    tl.instant("serving.scheduler", "ADMIT")
+    tl.instant("serving.scheduler", "ADMIT")
+    tl.instant("serving.scheduler", "RECYCLE")
+    tl.async_start("serving.requests", "REQ", 0)
+    tl.async_start("serving.requests", "REQ", 1)
+    tl.async_end("serving.requests", "REQ", 0)
+    tl.close()
+    return path
+
+
+def test_counter_series_aggregation(summary_mod, tmp_path):
+    """ph "C" series roll up to first/last/min/max/delta/per-step —
+    the SCHED occupancy and LIFECYCLE odometer views."""
+    path = _make_serving_trace(tmp_path)
+    s = summary_mod.summarize(summary_mod.load_events(str(path)))
+    sched = s["counters"]["SCHED"]
+    assert sched["queued"]["first"] == 3 and sched["queued"]["last"] == 0
+    assert sched["queued"]["delta"] == -3
+    assert sched["queued"]["samples"] == 4
+    assert sched["queued"]["per_step"] == -1.0
+    assert sched["free_blocks"]["min"] == 4
+    assert sched["free_blocks"]["max"] == 7
+    assert s["counters"]["LIFECYCLE"]["preemptions"]["delta"] == 1
+
+
+def test_instants_counted_by_name(summary_mod, tmp_path):
+    """Scheduler lifecycle instants (now true ph "i" events) are
+    counted by name; the close() terminator is excluded."""
+    path = _make_serving_trace(tmp_path)
+    s = summary_mod.summarize(summary_mod.load_events(str(path)))
+    assert s["ticks"]["ADMIT"] == 2 and s["ticks"]["RECYCLE"] == 1
+    assert "done" not in s["ticks"]
+
+
+def test_zero_width_x_back_compat(summary_mod):
+    """Pre-satellite traces wrote instants as ph "X", dur 0 — those
+    still count as ticks, never as tensors."""
+    events = [{"ph": "X", "name": "NEGOTIATE_TICK_r0", "pid": 1,
+               "ts": 1.0, "dur": 0}]
+    s = summary_mod.summarize(events)
+    assert s["ticks"]["NEGOTIATE_TICK_r0"] == 1
+    assert s["tensors"] == {}
+
+
+def test_async_span_aggregation(summary_mod, tmp_path):
+    """REQ b/e pairs matched by id: one closed span, one left open."""
+    path = _make_serving_trace(tmp_path)
+    s = summary_mod.summarize(summary_mod.load_events(str(path)))
+    req = s["spans"]["REQ"]
+    assert req["count"] == 1 and req["open"] == 1
+    assert req["max_us"] >= req["mean_us"] > 0.0
+
+
+def test_cli_json_mode(summary_mod, tmp_path, capsys):
+    path = _make_serving_trace(tmp_path)
+    assert summary_mod.main([str(path), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert set(s) >= {"tensors", "phase_totals", "ticks", "counters",
+                      "spans", "unbalanced"}
+    assert s["counters"]["SCHED"]["queued"]["last"] == 0
+
+
+def test_cli_counters_only_trace_summarizes(summary_mod, tmp_path, capsys):
+    """A serving trace with no tensor B/E events is still a summary,
+    not the 'no tensor events' bailout."""
+    path = _make_serving_trace(tmp_path)
+    assert summary_mod.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "counter SCHED" in out and "async spans" in out
+    assert "ADMIT=2" in out

@@ -4,11 +4,16 @@ The timeline models each tensor as a "process" whose pid groups its
 events (reference timeline.cc:51-67); chrome://tracing renders it, but a
 quick look during a run shouldn't need a browser:
 
-    python tools/timeline_summary.py /tmp/timeline.json [--top 20]
+    python tools/timeline_summary.py /tmp/timeline.json [--top 20] [--json]
 
 Prints per-tensor negotiation and execution durations, per-phase totals,
-and the negotiation tick counts per rank (NEGOTIATE_TICK_r<k> instants —
-reference timeline.cc:98-132 parity).
+the negotiation tick counts per rank (NEGOTIATE_TICK_r<k> instants —
+reference timeline.cc:98-132 parity), aggregated counter (``ph: "C"``)
+series — the serving scheduler's SCHED/LIFECYCLE/PREFIX tracks: final
+values plus the delta and sample count across the trace — and
+per-request async spans (the engine's ``REQ`` ``b``/``e`` pairs, one id
+per request).  ``--json`` dumps the whole summary dict as JSON for
+scripting.
 """
 
 from __future__ import annotations
@@ -40,6 +45,11 @@ def summarize(events: list[dict]) -> dict:
     durs: dict[tuple, float] = collections.defaultdict(float)
     args_by_pid: dict[int, dict] = {}
     ticks = collections.Counter()
+    # counter (ph "C") aggregation: activity -> series -> running stats
+    counters: dict[str, dict[str, dict]] = {}
+    # async (ph "b"/"e") spans: name -> list of closed durations (us)
+    span_durs: dict[str, list] = collections.defaultdict(list)
+    span_ids: dict[str, set] = collections.defaultdict(set)
 
     for e in events:
         ph = e.get("ph")
@@ -55,19 +65,39 @@ def summarize(events: list[dict]) -> dict:
                 durs[(pid, name)] += e["ts"] - stack.pop()
             if e.get("args"):
                 args_by_pid.setdefault(pid, e["args"])
+        elif ph == "i":
+            # True instant events (per-rank readiness ticks, mark_cycles
+            # engine ticks, scheduler lifecycle marks): counted by name.
+            if name != "done":              # skip the close() terminator
+                ticks[name] += 1
         elif ph == "X":
             if name.startswith("NEGOTIATE_TICK") or name == "CYCLE_START":
-                # Instants (per-rank readiness, mark_cycles engine ticks):
-                # counted, never tabulated as zero-duration "tensors".
+                # Back-compat: older traces wrote instants as zero-width
+                # complete events; count them, never tabulate as tensors.
                 ticks[name] += 1
             else:
                 durs[(pid, name)] += e.get("dur", 0.0)
+        elif ph == "C":
+            series = counters.setdefault(name, {})
+            for k, v in (e.get("args") or {}).items():
+                s = series.get(k)
+                if s is None:
+                    series[k] = {"first": v, "last": v, "min": v,
+                                 "max": v, "samples": 1}
+                else:
+                    s["last"] = v
+                    s["min"] = min(s["min"], v)
+                    s["max"] = max(s["max"], v)
+                    s["samples"] += 1
         elif ph == "b":
             open_b[(pid, name, e.get("id"))].append(e["ts"])
+            span_ids[name].add(e.get("id"))
         elif ph == "e":
             stack = open_b.get((pid, name, e.get("id")))
             if stack:
-                durs[(pid, name)] += e["ts"] - stack.pop()
+                d = e["ts"] - stack.pop()
+                durs[(pid, name)] += d
+                span_durs[name].append(d)
 
     unbalanced = sorted(
         k[1] for k, v in open_b.items() for _ in v   # one entry per open B
@@ -82,10 +112,28 @@ def summarize(events: list[dict]) -> dict:
     for pid, a in args_by_pid.items():
         if tensor_names.get(pid) in per_tensor:
             per_tensor[tensor_names[pid]]["args"] = a
+    # finalize counter series: delta over the trace + mean step delta
+    for series in counters.values():
+        for s in series.values():
+            s["delta"] = s["last"] - s["first"]
+            steps = max(s["samples"] - 1, 1)
+            s["per_step"] = s["delta"] / steps
+    spans = {
+        name: {
+            "count": len(ds),
+            "open": len(span_ids[name]) - len(ds),
+            "total_us": sum(ds),
+            "mean_us": sum(ds) / len(ds) if ds else 0.0,
+            "max_us": max(ds) if ds else 0.0,
+        }
+        for name, ds in span_durs.items()
+    }
     return {
         "tensors": per_tensor,
         "phase_totals": dict(phase_totals),
         "ticks": dict(ticks),
+        "counters": counters,
+        "spans": spans,
         "unbalanced": unbalanced,
     }
 
@@ -95,10 +143,15 @@ def main(argv=None) -> int:
     ap.add_argument("trace")
     ap.add_argument("--top", type=int, default=20,
                     help="show the N tensors with the largest total time")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full summary dict as JSON")
     args = ap.parse_args(argv)
 
     s = summarize(load_events(args.trace))
-    if not s["tensors"]:
+    if args.json:
+        print(json.dumps(s, indent=2, sort_keys=True))
+        return 0
+    if not s["tensors"] and not s["counters"]:
         print("no tensor events found")
         return 1
 
@@ -107,8 +160,20 @@ def main(argv=None) -> int:
                             key=lambda kv: -kv[1]):
         print(f"  {phase:32s} {us / 1e3:10.2f}")
     if s["ticks"]:
-        print("negotiation ticks:",
+        print("instants:",
               " ".join(f"{k}={v}" for k, v in sorted(s["ticks"].items())))
+    for activity, series in sorted(s["counters"].items()):
+        print(f"\ncounter {activity} (final / delta over "
+              f"{max(v['samples'] for v in series.values())} samples):")
+        for k, v in sorted(series.items()):
+            print(f"  {k:24s} last {v['last']:10g}  delta {v['delta']:10g}"
+                  f"  per-step {v['per_step']:8.3f}")
+    if s["spans"]:
+        print("\nasync spans:")
+        for name, sp in sorted(s["spans"].items()):
+            print(f"  {name:24s} n={sp['count']:5d} open={sp['open']:3d} "
+                  f"mean {sp['mean_us'] / 1e3:8.2f}ms "
+                  f"max {sp['max_us'] / 1e3:8.2f}ms")
 
     rows = sorted(
         s["tensors"].items(),
