@@ -509,6 +509,11 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
         "serve_e2e_p99_ms": round(r["serve_e2e_p99_ms"], 3),
         "serve_metrics_overhead_pct": round(
             r["serve_metrics_overhead_pct"], 2),
+        # SLO goodput over the timed pass's terminal traces, and the cost
+        # of serving /metrics scrapes DURING the decode loop (monitor-on
+        # pass with a live scraper thread vs the metrics-on pass).
+        "serve_goodput": round(r["serve_goodput"], 4),
+        "monitor_overhead_pct": round(r["monitor_overhead_pct"], 2),
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
                         f"req{len(reqs)}"),
     }

@@ -128,6 +128,7 @@ from horovod_tpu.timeline import start_timeline, stop_timeline  # noqa: F401
 from horovod_tpu import ops  # noqa: F401
 from horovod_tpu import elastic  # noqa: F401  (hvd.elastic.State / .run)
 from horovod_tpu import metrics  # noqa: F401  (hvd.metrics.DEFAULT / .snapshot)
+from horovod_tpu import monitor  # noqa: F401  (hvd.monitor.MonitorServer / aggregate_snapshots)
 from horovod_tpu.basics import HorovodInternalError  # noqa: F401
 
 __version__ = "0.1.0"

@@ -398,6 +398,10 @@ def init(
         _post_host_card(_state)
         _state.initialized = True
         _state.shut_down = False
+    # Pin the rank identity stamped on event-log records / state dumps
+    # (outside the lock: rank() re-enters _require_init's read path).
+    from horovod_tpu import metrics as metrics_mod
+    metrics_mod.set_rank(rank())
     atexit.register(shutdown)
 
 
@@ -430,6 +434,8 @@ def shutdown() -> None:
         engine.shutdown()
     if timeline is not None:
         timeline.close()
+    from horovod_tpu import metrics as metrics_mod
+    metrics_mod.set_rank(None)
 
 
 def is_initialized() -> bool:

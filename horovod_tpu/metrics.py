@@ -99,6 +99,54 @@ LIFECYCLE_EVENT_COUNTERS: dict[str, str] = {
     "serve.fail": "failures",
 }
 
+#: Canonical one-line descriptions for every registry metric the codebase
+#: emits by literal name — ``to_prometheus()`` renders these as ``# HELP``
+#: lines, and ``tools/check_counter_names.py`` lints call sites against
+#: this table both directions (a new literal metric name MUST land here).
+#: Dynamic families (``"serve." + key`` mirrors of ``ServeEngine.counters``,
+#: ``"prefix." + key`` mirrors of the prefix-cache counters) are covered by
+#: the ``serve.<lifecycle>`` / ``prefix.<series>`` entries below.
+METRIC_HELP: dict[str, str] = {
+    # hvd.* — collectives / negotiation / cross-rank step health
+    "hvd.allreduce_bytes": "Per-rank eager allreduce payload bytes dispatched",
+    "hvd.negotiate_polls": "KV-store poll iterations spent negotiating collective readiness",
+    "hvd.negotiate_timeouts": "Negotiation rounds abandoned after the stall timeout",
+    "hvd.negotiate_s": "Seconds from eager-op enqueue to negotiated dispatch",
+    "hvd.step_s": "Per-rank engine/training step wall time in seconds",
+    "hvd.step_skew_s": "Slowest-minus-median rank step time over the straggler window",
+    # serve.* — ServeEngine request latencies and occupancy
+    "serve.queue_wait_s": "Seconds a request waited from submit to first admission",
+    "serve.ttft_s": "Seconds from submit to first emitted token",
+    "serve.e2e_s": "Seconds from submit to terminal status",
+    "serve.tpot_s": "Seconds per output token after the first (decode cadence)",
+    "serve.steps": "Engine scheduler steps executed",
+    "serve.queue_depth": "Requests waiting for admission",
+    "serve.decoding": "Slots actively decoding",
+    "serve.prefilling": "Slots mid-prefill",
+    "serve.free_blocks": "Free KV-cache pages",
+    "serve.cached_blocks": "KV-cache pages retained by the prefix cache",
+    "serve.goodput": "Fraction of windowed terminal requests that finished OK within SLO",
+    # serve.* lifecycle counters mirrored from ServeEngine.counters
+    "serve.requests_submitted": "Requests accepted by submit()",
+    "serve.requests_completed": "Requests reaching a terminal status",
+    "serve.tokens_emitted": "Output tokens emitted across all requests",
+    "serve.preemptions": "Scheduler preemptions (victim returned to queue)",
+    "serve.timeouts": "Requests terminated by deadline expiry",
+    "serve.cancellations": "Requests cancelled by the caller",
+    "serve.rejections": "Requests load-shed after max_queue_steps",
+    "serve.retries": "Fault-triggered replays of a request",
+    "serve.failures": "Requests terminated FAILED after exhausting retries",
+    "serve.prefix_indexed_blocks": "KV pages indexed by the radix prefix cache",
+    # prefix.* — RadixPrefixCache counters mirrored from prefix_counters
+    "prefix.hits": "Admissions that reused prefix-cache blocks",
+    "prefix.blocks_reused": "KV pages spliced from the prefix cache",
+    "prefix.tokens_skipped": "Prompt tokens skipped via prefix reuse",
+    "prefix.evictions": "Prefix-cache pages evicted under pressure",
+    # monitor.* — the cross-rank observability layer itself
+    "monitor.scrapes": "HTTP requests served by the /metrics exporter",
+    "monitor.aggregations": "Cross-rank aggregate_snapshots() rounds completed",
+}
+
 
 # ---------------------------------------------------------------------------
 # Instruments.
@@ -160,6 +208,31 @@ def log_bucket_bounds(lo: float = 1e-6, hi: float = 1e3,
     return tuple(lo * 10 ** (i / per_decade) for i in range(n + 1))
 
 
+def percentile_from_buckets(bounds: tuple[float, ...] | list[float],
+                            counts: list[int], count: int,
+                            mn: float, mx: float, q: float) -> float:
+    """Estimate the ``q``-quantile from fixed-bucket counts; 0.0 when
+    empty.  This is THE quantile code path — :class:`Histogram` and
+    :func:`horovod_tpu.monitor.merge_snapshots` both call it, which is
+    what makes a merged fleet histogram's p50/p90/p99 bit-identical to a
+    single-process histogram over the union of observations."""
+    if count == 0:
+        return 0.0
+    rank = q * count
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else mx
+            frac = (rank - cum) / c
+            est = lo + (hi - lo) * max(frac, 0.0)
+            return min(max(est, mn), mx)
+        cum += c
+    return mx
+
+
 class Histogram:
     """Fixed-log-bucket histogram with quantile estimation.
 
@@ -216,28 +289,20 @@ class Histogram:
             return self._percentile_locked(q)
 
     def _percentile_locked(self, q: float) -> float:
-        if self._count == 0:
-            return 0.0
-        rank = q * self._count
-        cum = 0.0
-        for i, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) else self._max
-                frac = (rank - cum) / c
-                est = lo + (hi - lo) * max(frac, 0.0)
-                return min(max(est, self._min), self._max)
-            cum += c
-        return self._max
+        return percentile_from_buckets(self.bounds, self._counts,
+                                       self._count, self._min, self._max, q)
 
     def snapshot(self) -> dict:
-        """Schema-stable summary: count/sum/min/max + p50/p90/p99."""
+        """Schema-stable summary: count/sum/min/max + p50/p90/p99, plus
+        the raw ``buckets`` counts and their ``bounds`` — the mergeable
+        form :func:`horovod_tpu.monitor.merge_snapshots` sums exactly
+        (one extra slot past ``bounds`` is the overflow bucket)."""
         with self._lock:
             if self._count == 0:
                 return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
-                        "p50": 0.0, "p90": 0.0, "p99": 0.0}
+                        "p50": 0.0, "p90": 0.0, "p99": 0.0,
+                        "buckets": list(self._counts),
+                        "bounds": list(self.bounds)}
             return {
                 "count": self._count,
                 "sum": self._sum,
@@ -246,7 +311,41 @@ class Histogram:
                 "p50": self._percentile_locked(0.50),
                 "p90": self._percentile_locked(0.90),
                 "p99": self._percentile_locked(0.99),
+                "buckets": list(self._counts),
+                "bounds": list(self.bounds),
             }
+
+
+# ---------------------------------------------------------------------------
+# Rank identity (stamped onto event-log records and state dumps).
+# ---------------------------------------------------------------------------
+
+# This module imports nothing from horovod_tpu, so the rank arrives by
+# push: ``basics.init()`` calls ``set_rank()`` once the mesh is up.
+# Before that (or in single-process tests) the launcher env var is the
+# best available answer, matching jax.distributed's process index.
+_RANK_LOCK = threading.Lock()
+_RANK: int | None = None
+
+
+def set_rank(r: int | None) -> None:
+    """Pin the rank stamped on event-log records (``basics.init()`` /
+    ``shutdown()`` call this; tests may too)."""
+    global _RANK
+    with _RANK_LOCK:
+        _RANK = None if r is None else int(r)
+
+
+def current_rank() -> int:
+    """The rank identity for log attribution: the value ``set_rank()``
+    pinned, else ``HOROVOD_TPU_PROCESS_ID`` from the launcher, else 0."""
+    with _RANK_LOCK:
+        if _RANK is not None:
+            return _RANK
+    try:
+        return int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -270,7 +369,9 @@ class EventLog:
         self._file: IO[str] | None = open(path, "a")
 
     def emit(self, kind: str, **fields: Any) -> None:
-        line = json.dumps({"ts": time.time(), "kind": kind, **fields})
+        line = json.dumps({"ts": time.time(), "kind": kind,
+                           "rank": current_rank(), "pid": os.getpid(),
+                           **fields})
         with self._lock:
             if self._file is None:
                 return
@@ -328,6 +429,20 @@ def _prom_name(name: str) -> str:
     """Prometheus metric names allow [a-zA-Z0-9_:] — dots become
     underscores (``serve.ttft_s`` → ``serve_ttft_s``)."""
     return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def escape_label_value(v: str) -> str:
+    """Escape a label VALUE per the Prometheus 0.0.4 exposition spec:
+    backslash, double-quote, and line-feed must be escaped inside the
+    ``name="value"`` quotes."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """Escape a ``# HELP`` docstring per the 0.0.4 spec: backslash and
+    line-feed only (quotes are legal in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class MetricsRegistry:
@@ -405,30 +520,40 @@ class MetricsRegistry:
         }
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format, version 0.0.4: ``# TYPE``
-        lines plus samples; histograms render cumulative ``_bucket``
-        series with ``le`` labels, ``_sum`` and ``_count``."""
+        """Prometheus text exposition format, version 0.0.4: ``# HELP``
+        (from :data:`METRIC_HELP`) and ``# TYPE`` lines plus samples;
+        histograms render cumulative ``_bucket`` series with ``le``
+        labels, ``_sum`` and ``_count``.  Label values are escaped per
+        the spec via :func:`escape_label_value`."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
         lines: list[str] = []
+
+        def _head(name: str, pn: str, kind: str) -> None:
+            help_text = METRIC_HELP.get(name)
+            if help_text:
+                lines.append(f"# HELP {pn} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {pn} {kind}")
+
         for name, c in sorted(counters.items()):
             pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} counter")
+            _head(name, pn, "counter")
             lines.append(f"{pn} {c.value}")
         for name, g in sorted(gauges.items()):
             pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} gauge")
+            _head(name, pn, "gauge")
             lines.append(f"{pn} {g.value:g}")
         for name, h in sorted(histograms.items()):
             pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} histogram")
+            _head(name, pn, "histogram")
             with h._lock:
                 cum = 0
                 for edge, c in zip(h.bounds, h._counts):
                     cum += c
-                    lines.append(f'{pn}_bucket{{le="{edge:g}"}} {cum}')
+                    le = escape_label_value(f"{edge:g}")
+                    lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
                 lines.append(f'{pn}_bucket{{le="+Inf"}} {h._count}')
                 lines.append(f"{pn}_sum {h._sum:g}")
                 lines.append(f"{pn}_count {h._count}")

@@ -224,6 +224,11 @@ class EagerEngine:
             "ops_enqueued": 0, "batches_dispatched": 0, "tensors_fused": 0,
             "allreduce_bytes": 0, "errors": 0, "stall_warnings": 0,
         })
+        # Last-N negotiate waits (enqueue → dispatch) for the straggler
+        # detector's rolling window; deque appends are atomic, so the
+        # flush thread writes and engine_stats() snapshots lock-free.
+        self.recent_negotiate_s: collections.deque[float] = (
+            collections.deque(maxlen=256))
         self._cycle_thread = threading.Thread(
             target=self._cycle_loop, name="horovod_tpu-engine", daemon=True
         )
@@ -629,8 +634,9 @@ class EagerEngine:
         # op, the same span the timeline's NEGOTIATE phase draws — but
         # scrapeable with no timeline attached.
         if p.enqueued_at:
-            metrics_mod.DEFAULT.histogram("hvd.negotiate_s").observe(
-                time.monotonic() - p.enqueued_at)
+            wait = time.monotonic() - p.enqueued_at
+            metrics_mod.DEFAULT.histogram("hvd.negotiate_s").observe(wait)
+            self.recent_negotiate_s.append(wait)
         if self.timeline:
             self.timeline.end(
                 p.name, timeline_mod.NEGOTIATE + "_" + p.kind.upper()
@@ -1526,10 +1532,16 @@ def engine_stats() -> dict:
     ``stall_warnings`` (stall-checker firings).
     Values are monotonic since ``init()``; before the engine's first eager
     op this reports ``{}``.  A snapshot, not a barrier: in-flight ops may
-    not be counted yet.
+    not be counted yet.  ``recent_negotiate_s`` is the last-N negotiate
+    waits (enqueue → dispatch, seconds) — the straggler detector's
+    rolling-window feed.
     """
     eng = basics._state.engine
-    return dict(eng.stats) if eng is not None else {}
+    if eng is None:
+        return {}
+    out: dict = dict(eng.stats)
+    out["recent_negotiate_s"] = list(eng.recent_negotiate_s)
+    return out
 
 
 def take_handle_post(handle: int):

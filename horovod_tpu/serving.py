@@ -73,7 +73,14 @@ class Request:
     ``max_queue_steps``: admission budget in ENGINE STEPS — a request
     still queued after this many steps (per queue stint; a preempted
     request's replay restarts the count) is load-shed with a
-    ``REJECTED`` result.  Step-counted so tests never sleep."""
+    ``REJECTED`` result.  Step-counted so tests never sleep.
+
+    ``slo_s``: SOFT end-to-end latency target for SLO accounting — a
+    request finishing OK but slower than this counts against the
+    engine's windowed ``serve.goodput``
+    (:class:`~horovod_tpu.monitor.SLOWindow`).  Unlike ``deadline_s``
+    it never changes scheduling or the result: the request still
+    completes and returns its tokens."""
 
     prompt: list[int]
     max_new_tokens: int
@@ -83,6 +90,7 @@ class Request:
     temperature: float | None = None
     deadline_s: float | None = None
     max_queue_steps: int | None = None
+    slo_s: float | None = None
 
 
 # Terminal request statuses (ServeEngine request lifecycle).

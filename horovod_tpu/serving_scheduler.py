@@ -94,6 +94,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from functools import partial
 from typing import Any
@@ -104,6 +105,7 @@ import numpy as np
 
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
+from horovod_tpu import monitor as monitor_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import RadixPrefixCache
@@ -242,7 +244,11 @@ class ServeEngine:
                  watchdog_steps: int = 256,
                  faults: "faults_mod.FaultRegistry | None" = None,
                  metrics: "metrics_mod.MetricsRegistry | None" = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 monitor: "monitor_mod.MonitorServer | int | bool | None"
+                     = None,
+                 slo_window: int = 256,
+                 slo_e2e_s: float | None = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
                              f"{max_len}]")
@@ -269,6 +275,30 @@ class ServeEngine:
                   "serve.e2e_s"):
             self.metrics.histogram(h)
         self._t0 = time.monotonic()
+        self._last_step_ts: float | None = None
+        # SLO goodput window: every terminal trace lands here; the
+        # serve.goodput gauge tracks the windowed good fraction.
+        self.slo = monitor_mod.SLOWindow(window=slo_window,
+                                         slo_e2e_s=slo_e2e_s)
+        self._slo_targets: dict[int, float | None] = {}
+        # Live exporter: False = off; None = env-driven
+        # (HVD_TPU_MONITOR_PORT); int = bind that port; an existing
+        # MonitorServer re-attaches to this engine.
+        if monitor is False:
+            self.monitor = None
+        elif monitor is None:
+            self.monitor = monitor_mod.maybe_start_monitor(
+                self.metrics, self)
+        elif isinstance(monitor, monitor_mod.MonitorServer):
+            monitor.attach_engine(self)
+            self.monitor = monitor
+        elif isinstance(monitor, int) and monitor is not True:
+            self.monitor = monitor_mod.MonitorServer(
+                self.metrics, self, port=monitor).start()
+        else:
+            raise ValueError(
+                f"monitor must be None / False / port int / "
+                f"MonitorServer, got {monitor!r}")
         self.pcache = llama.init_paged_cache(
             cfg, n_slots, max_len, block_size=block_size,
             n_blocks=n_blocks)
@@ -371,8 +401,17 @@ class ServeEngine:
     def metrics_snapshot(self) -> dict:
         """Plain-dict snapshot of the engine's registry: counters,
         gauges, and the TTFT / TPOT / queue-wait / e2e histograms with
-        p50/p90/p99 — queryable with no timeline attached."""
-        return self.metrics.snapshot()
+        p50/p90/p99 — plus the windowed ``slo`` report — queryable with
+        no timeline attached."""
+        snap = self.metrics.snapshot()
+        snap["slo"] = self.slo_report()
+        return snap
+
+    def slo_report(self) -> dict:
+        """The SLO window's answer to "are we meeting SLOs *now*":
+        goodput, status mix, and windowed TTFT/TPOT/E2E percentiles over
+        the last ``slo_window`` terminal requests."""
+        return self.slo.report()
 
     def state_dump(self) -> str:
         """Human-readable scheduler state (the watchdog's evidence):
@@ -386,6 +425,7 @@ class ServeEngine:
         for r in self.results.values():
             by_status[r.status] = by_status.get(r.status, 0) + 1
         lines = [
+            f"rank={metrics_mod.current_rank()} pid={os.getpid()} "
             f"step={self.step_index} uptime_s="
             f"{time.monotonic() - self._t0:.3f} "
             f"queue_depth={len(self._queue)} "
@@ -446,6 +486,8 @@ class ServeEngine:
             raise ValueError(
                 "ServeEngine does not splice prefix caches yet; use "
                 "ContinuousBatcher for prefix requests")
+        if req.slo_s is not None and req.slo_s <= 0:
+            raise ValueError(f"slo_s must be positive, got {req.slo_s}")
         if L + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {L} + max_new_tokens {req.max_new_tokens} "
@@ -468,6 +510,7 @@ class ServeEngine:
                                        deadline=deadline))
         self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
                                  enqueue_step=self.step_index)
+        self._slo_targets[rid] = req.slo_s
         self.metrics.counter("serve.requests_submitted").inc()
         self.metrics.event("serve.submit", rid=rid, step=self.step_index,
                            prompt_len=L,
@@ -764,6 +807,8 @@ class ServeEngine:
         tr.status = res.status
         tr.n_tokens = len(res.tokens)
         res.trace = tr
+        self.slo.add(tr, self._slo_targets.pop(rid, None))
+        self.metrics.gauge("serve.goodput").set(self.slo.goodput())
         self.metrics.histogram("serve.e2e_s").observe(tr.e2e_s)
         tpot = tr.tpot_s
         if tpot is not None:
@@ -1062,6 +1107,7 @@ class ServeEngine:
                     f"is stuck.  State:\n{self.state_dump()}")
         else:
             self._idle_steps = 0
+        self._last_step_ts = time.monotonic()
         self.step_index += 1
         return self._finished
 
@@ -1100,8 +1146,10 @@ def measure_throughput(
     latency percentiles from the metrics-on pass
     (``serve_ttft_p50_ms`` .. ``serve_e2e_p99_ms``),
     ``serve_metrics_overhead_pct`` (instrumented vs null-registry pass —
-    the acceptance bound for the observability layer is < 2 %) and
-    workload shape fields.
+    the acceptance bound for the observability layer is < 2 %),
+    ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz vs
+    exporter off), ``serve_goodput`` (windowed SLO goodput after the
+    timed passes) and workload shape fields.
     """
     if not requests:
         raise ValueError("empty workload")
@@ -1135,6 +1183,36 @@ def measure_throughput(
     hist = {name: reg.histogram(name)
             for name in ("serve.ttft_s", "serve.tpot_s",
                          "serve.queue_wait_s", "serve.e2e_s")}
+
+    # fourth pass: exporter ON and actively scraped — a sidecar polling
+    # /metrics while the engine serves.  The delta vs the metrics-on
+    # pass prices the monitor itself (lock contention + render cost).
+    eng.metrics = metrics_mod.MetricsRegistry(event_log=None)
+    mon = monitor_mod.MonitorServer(eng.metrics, eng, port=0).start()
+    stop_scraping = threading.Event()
+
+    def _scrape_loop() -> None:
+        import urllib.request
+        url = f"http://{mon.host}:{mon.port}/metrics"
+        while not stop_scraping.is_set():
+            try:
+                urllib.request.urlopen(url, timeout=1).read()
+            except OSError:
+                pass
+            stop_scraping.wait(0.01)
+
+    scraper = threading.Thread(target=_scrape_loop, daemon=True)
+    scraper.start()
+    try:
+        t0 = time.perf_counter()
+        mon_out = eng.run(requests)
+        jax.block_until_ready(eng.pcache.k)
+        t_serve_mon = time.perf_counter() - t0
+    finally:
+        stop_scraping.set()
+        scraper.join(timeout=5)
+        mon.stop()
+    assert [len(t) for t in mon_out] == [len(t) for t in warm]
 
     # static baseline: batches of n_slots, one compiled generate per
     # distinct batch budget (compiles excluded by per-batch warmup)
@@ -1179,6 +1257,9 @@ def measure_throughput(
         "serve_e2e_p99_ms": hist["serve.e2e_s"].percentile(0.99) * 1e3,
         "serve_metrics_overhead_pct":
             (t_serve - t_serve_off) / t_serve_off * 100.0,
+        "monitor_overhead_pct":
+            (t_serve_mon - t_serve) / t_serve * 100.0,
+        "serve_goodput": eng.slo.goodput(),
         "tokens": n_tokens,
         "n_requests": len(requests),
         "n_slots": n_slots,

@@ -200,10 +200,17 @@ def trace_annotation(name: str):
 def maybe_create(path: str | None,
                  mark_cycles: bool = False) -> Timeline | None:
     """Create a timeline if configured.  Rank-0-only in multi-host jobs
-    (reference operations.cc:1614-1618 gates on is_coordinator)."""
+    (reference operations.cc:1614-1618 gates on is_coordinator) —
+    UNLESS ``path`` contains a ``{rank}`` template, in which case EVERY
+    rank writes its own file (``trace_{rank}.json`` →
+    ``trace_0.json`` ...), the per-rank inputs
+    ``tools/timeline_summary.py --merge`` stitches into one fleet
+    trace."""
     if not path:
         return None
-    if jax.process_index() != 0:
+    if "{rank}" in path:
+        path = path.replace("{rank}", str(jax.process_index()))
+    elif jax.process_index() != 0:
         return None
     dirname = os.path.dirname(path)
     if dirname:

@@ -1,0 +1,106 @@
+"""Worker for the cross-rank metric aggregation test (monitor pillar 2).
+
+Launched by tests/test_multiprocess.py as N real OS processes (same
+coordination env as multiprocess_worker.py).  Each rank feeds its OWN
+distinct observations into a private registry — dyadic rationals, so
+every float sum is exact — then calls ``aggregate_snapshots()``, which
+rides the engine's allgather plane.  The fleet view each rank prints
+must be BYTE-IDENTICAL across ranks (the launcher asserts it), and its
+histogram must match the union of all ranks' observations exactly.
+
+Also exercises the live exporter under a real gang: every rank starts a
+``MonitorServer`` on an ephemeral port and scrapes ITSELF over
+localhost, proving exporter-per-rank coexistence in one host.
+
+Prints one final line ``WORKER_OK {json}`` on success.
+"""
+
+import faulthandler
+import json
+import os
+import sys
+
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import urllib.request
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics as metrics_mod
+    from horovod_tpu import monitor as monitor_mod
+
+    hvd.init()
+    me = hvd.cross_rank()
+    n = hvd.cross_size()
+    assert metrics_mod.current_rank() == hvd.rank()
+
+    # Distinct per-rank payloads: rank r observes (r*50 + i)/256 for
+    # i in [0, 50) — disjoint dyadic values, exact sums, and counter
+    # weights that make mis-attribution visible in the totals.
+    reg = metrics_mod.MetricsRegistry(event_log=None)
+    reg.counter("serve.steps").inc(10 * (me + 1))
+    reg.gauge("serve.queue_depth").set(float(me))
+    h = reg.histogram("serve.e2e_s")
+    for i in range(50):
+        h.observe((me * 50 + i) / 256.0)
+
+    fleet = monitor_mod.aggregate_snapshots(reg)
+
+    # Every rank recomputes the expected union locally and checks its
+    # OWN fleet view against it — plus the launcher cross-checks that
+    # all ranks printed the identical payload.
+    union = metrics_mod.MetricsRegistry(event_log=None)
+    uh = union.histogram("serve.e2e_s")
+    for r in range(n):
+        for i in range(50):
+            uh.observe((r * 50 + i) / 256.0)
+    expect = union.snapshot()["histograms"]["serve.e2e_s"]
+    got = fleet["histograms"]["serve.e2e_s"]
+    assert got == expect, (got, expect)        # bit-identical union
+    assert fleet["counters"]["serve.steps"] == sum(
+        10 * (r + 1) for r in range(n))
+    assert fleet["gauges"]["serve.queue_depth"]["per_rank"] == {
+        r: float(r) for r in range(n)}
+    assert fleet["ranks"] == list(range(n))
+
+    # Straggler check over the real allgather plane: rank's own steps
+    # in, everyone agrees on the verdict (encoded into the payload).
+    det = monitor_mod.StragglerDetector(reg, window=8, warn_s=1e9)
+    for _ in range(4):
+        det.record_step(0.01 * (me + 1))
+    verdict = det.check()
+    assert len(verdict["reports"]) == n
+    assert verdict["slowest_rank"] == n - 1    # largest synthetic step
+
+    # Exporter-per-rank on one host: scrape myself over localhost.
+    mon = monitor_mod.MonitorServer(reg, port=0).start()
+    with urllib.request.urlopen(
+            f"http://{mon.host}:{mon.port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert f"serve_steps {10 * (me + 1)}" in text
+    mon.stop()
+
+    # One canonical payload per rank; the launcher asserts byte equality
+    # across ranks (sort_keys makes dict order deterministic).
+    payload = {
+        "fleet": fleet,
+        "skew_s": verdict["skew_s"],
+        "slowest_rank": verdict["slowest_rank"],
+    }
+    hvd.shutdown()
+    print("WORKER_OK " + json.dumps(payload, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

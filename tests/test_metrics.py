@@ -117,7 +117,9 @@ def test_histogram_empty_and_bad_args():
     h = Histogram("h", threading.Lock())
     assert h.percentile(0.5) == 0.0
     assert h.snapshot() == {"count": 0, "sum": 0.0, "min": 0.0,
-                            "max": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+                            "max": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+                            "buckets": [0] * (len(h.bounds) + 1),
+                            "bounds": list(h.bounds)}
     with pytest.raises(ValueError):
         h.percentile(1.5)
     with pytest.raises(ValueError):
@@ -136,8 +138,8 @@ def test_registry_get_or_create_and_type_conflicts():
 
 def test_snapshot_schema_stable():
     """The documented shape: counters/gauges/histograms at the top,
-    count/sum/min/max/p50/p90/p99 per histogram — and nothing else
-    (dashboards key on these names)."""
+    count/sum/min/max/p50/p90/p99 plus the mergeable buckets/bounds per
+    histogram — and nothing else (dashboards key on these names)."""
     reg = MetricsRegistry(event_log=None)
     reg.counter("serve.steps").inc(3)
     reg.gauge("serve.queue_depth").set(2)
@@ -147,7 +149,9 @@ def test_snapshot_schema_stable():
     assert snap["counters"] == {"serve.steps": 3}
     assert snap["gauges"] == {"serve.queue_depth": 2.0}
     assert set(snap["histograms"]["serve.ttft_s"]) == {
-        "count", "sum", "min", "max", "p50", "p90", "p99"}
+        "count", "sum", "min", "max", "p50", "p90", "p99",
+        "buckets", "bounds"}
+    assert sum(snap["histograms"]["serve.ttft_s"]["buckets"]) == 1
     json.dumps(snap)                       # JSON-serializable end to end
 
 

@@ -21,6 +21,7 @@ import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multiprocess_worker.py")
+MONITOR_WORKER = os.path.join(HERE, "multiprocess_monitor_worker.py")
 
 
 def _free_port() -> int:
@@ -93,6 +94,33 @@ def test_two_process_end_to_end(tmp_path):
     )
     for i, out in enumerate(outs):
         assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+
+
+@pytest.mark.slow
+def test_two_process_metric_aggregation():
+    """Cross-rank observability acceptance: ``aggregate_snapshots()``
+    over the real allgather plane returns the SAME fleet view on every
+    rank — byte-identical payloads — with the merged histogram equal to
+    the union of both ranks' observations (each worker checks that
+    exactly; see multiprocess_monitor_worker.py)."""
+    outs = _run_workers(
+        MONITOR_WORKER, 2,
+        {
+            "HOROVOD_TPU_NATIVE_CONTROLLER": "on",
+            "HOROVOD_TPU_CONTROLLER_TRANSPORT":
+                f"tcp:127.0.0.1:{_free_port()}",
+        },
+    )
+    payloads = []
+    for i, out in enumerate(outs):
+        assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+        payloads.append(
+            out.split("WORKER_OK ", 1)[1].splitlines()[0])
+    assert payloads[0] == payloads[1], (
+        "fleet views differ across ranks:\n" + "\n---\n".join(payloads))
+    fleet = json.loads(payloads[0])["fleet"]
+    assert fleet["counters"]["serve.steps"] == 30          # 10 + 20
+    assert fleet["histograms"]["serve.e2e_s"]["count"] == 100
 
 
 @pytest.mark.slow

@@ -174,3 +174,99 @@ def test_cli_counters_only_trace_summarizes(summary_mod, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "counter SCHED" in out and "async spans" in out
     assert "ADMIT=2" in out
+
+
+def _make_rank_traces(tmp_path, n=2):
+    """One trace per rank, written the way maybe_create's ``{rank}``
+    template produces them: same tensor names/pids in every file, plus
+    per-rank serving counters so fleet aggregation is observable."""
+    from horovod_tpu.timeline import Timeline
+
+    paths = []
+    for rank in range(n):
+        path = tmp_path / f"tl_{rank}.json"
+        tl = Timeline(str(path))
+        tl.start("grad/w1", "NEGOTIATE_ALLREDUCE")
+        tl.instant("grad/w1", f"NEGOTIATE_TICK_r{rank}")
+        tl.end("grad/w1", "NEGOTIATE_ALLREDUCE")
+        tl.start("grad/w1", "ALLREDUCE")
+        tl.end("grad/w1", "ALLREDUCE", {"dtype": "float32", "shape": [4]})
+        tl.counter("serving.scheduler", "SCHED", {"queued": rank})
+        tl.counter("serving.scheduler", "SCHED", {"queued": rank + 2})
+        tl.close()
+        paths.append(str(path))
+    return paths
+
+
+def test_merge_chrome_one_lane_per_rank(summary_mod, tmp_path):
+    """merge_chrome: pid becomes the rank (one chrome://tracing lane per
+    rank), tensor pids survive as tids, and per-tensor process_name
+    metadata is re-emitted as per-rank thread_name rows."""
+    paths = _make_rank_traces(tmp_path)
+    merged = summary_mod.merge_chrome(paths)
+
+    lanes = {e["pid"]: e["args"]["name"] for e in merged
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert lanes == {0: "rank 0", 1: "rank 1"}
+    assert {e["pid"] for e in merged if e.get("ph") != "M"} == {0, 1}
+
+    threads = [e for e in merged
+               if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert {t["pid"] for t in threads} == {0, 1}
+    assert all("tid" in t and t["args"]["name"] for t in threads)
+    # Every data event keeps its original tensor pid as the tid.
+    for e in merged:
+        if e.get("ph") in ("B", "E"):
+            assert e["tid"] in {t["tid"] for t in threads if t["pid"] == e["pid"]}
+
+
+def test_merge_summary_prefixes_tensors_and_aggregates(summary_mod, tmp_path):
+    """merge_for_summary: tensors split per rank (``r<k>/`` prefix, no
+    cross-rank B/E pairing) while counter series and ticks aggregate
+    fleet-wide."""
+    paths = _make_rank_traces(tmp_path)
+    s = summary_mod.summarize(summary_mod.merge_for_summary(paths))
+    assert set(s["tensors"]) == {"r0/grad/w1", "r1/grad/w1"}
+    for name in s["tensors"]:
+        assert s["tensors"][name]["phases"]["ALLREDUCE"] >= 0.0
+    assert s["unbalanced"] == []
+    # One tick per rank, distinct names — both visible in the fleet view.
+    assert s["ticks"]["NEGOTIATE_TICK_r0"] == 1
+    assert s["ticks"]["NEGOTIATE_TICK_r1"] == 1
+    # Counter series aggregate across ranks: 2 samples per rank.
+    assert s["counters"]["SCHED"]["queued"]["samples"] == 4
+    assert s["counters"]["SCHED"]["queued"]["min"] == 0
+    assert s["counters"]["SCHED"]["queued"]["max"] == 3
+
+
+def test_cli_merge_writes_trace_and_summarizes(summary_mod, tmp_path, capsys):
+    paths = _make_rank_traces(tmp_path)
+    out = tmp_path / "fleet.json"
+    assert summary_mod.main(
+        ["--merge", *paths, "--out", str(out), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["ranks"] == 2
+    assert set(s["tensors"]) == {"r0/grad/w1", "r1/grad/w1"}
+    stitched = json.load(open(out))
+    assert {e["pid"] for e in stitched if e.get("ph") != "M"} == {0, 1}
+
+
+def test_cli_merge_arg_validation(summary_mod, tmp_path):
+    path = _make_rank_traces(tmp_path, n=1)[0]
+    with pytest.raises(SystemExit):
+        summary_mod.main([path, "--merge", path])      # both given
+    with pytest.raises(SystemExit):
+        summary_mod.main([])                           # neither given
+    with pytest.raises(SystemExit):
+        summary_mod.main([path, "--out", "x.json"])    # --out sans --merge
+
+
+def test_maybe_create_rank_template_writes_per_rank_file(tmp_path):
+    """The ``{rank}`` template makes EVERY rank write a trace (the
+    --merge input contract); a plain path stays rank-0-only."""
+    from horovod_tpu import timeline as timeline_mod
+
+    tl = timeline_mod.maybe_create(str(tmp_path / "t_{rank}.json"))
+    assert tl is not None
+    tl.close()
+    assert (tmp_path / "t_0.json").exists()

@@ -1,11 +1,14 @@
 """Lint: every observability name in the code is in the canonical tables.
 
-Dashboards and the timeline-summary tool key on three name families —
+Dashboards and the timeline-summary tool key on four name families —
 Chrome-trace counter activities (``timeline.counter("track", "SCHED",
-{...})``), fault-injection sites (``faults.check("serve.tick", ...)``)
-and the event-log lifecycle kinds — all declared once in
+{...})``), fault-injection sites (``faults.check("serve.tick", ...)``),
+the event-log lifecycle kinds, and registry metric names
+(``metrics.counter("monitor.scrapes")`` / ``hvd.step_*`` /
+``serve.goodput`` ...) — all declared once in
 :mod:`horovod_tpu.metrics` (``TIMELINE_COUNTER_SERIES``,
-``FAULT_SITES``, ``LIFECYCLE_EVENT_COUNTERS``).  This tool greps the
+``FAULT_SITES``, ``LIFECYCLE_EVENT_COUNTERS``, ``METRIC_HELP``).
+This tool greps the
 package source for actual call sites and asserts membership BOTH ways:
 an unregistered name in code fails (a dashboard would silently miss
 it), and a registered name with no call site fails (dead table entries
@@ -32,14 +35,25 @@ _TIMELINE_COUNTER = re.compile(
 _SERIES_KEY = re.compile(r"[\"']([a-z_]+)[\"']\s*:")
 # faults.check("<site>", ...) — sites are dotted lowercase names
 _FAULT_SITE = re.compile(r"\.check\(\s*[\"']([a-z0-9_.]+)[\"']")
+# registry.counter/gauge/histogram("<name>"...) with a LITERAL name —
+# the closing quote must be followed by `,` or `)` so composed names
+# ("serve." + key) and f-strings stay out of scope (their families are
+# covered by table entries directly).
+_REGISTRY_METRIC = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\"']([a-z0-9_.]+)[\"']\s*[,)]")
+# a timeline.counter first argument looks identical up to the comma;
+# disambiguate by what FOLLOWS: an uppercase activity string literal.
+_ACTIVITY_NEXT = re.compile(r"\s*[\"'][A-Z]")
 
 
-def scan() -> tuple[dict[str, set], set, list[str]]:
+def scan() -> tuple[dict[str, set], set, set, list[str]]:
     """Walk the package source; returns (activity -> literal series
-    keys seen), the fault sites seen, and any per-site problems."""
+    keys seen), the fault sites seen, the literal registry metric
+    names seen, and any per-site problems."""
     problems: list[str] = []
     activities: dict[str, set] = {}
     sites: set = set()
+    metric_names: set = set()
     for path in sorted(PKG.rglob("*.py")):
         text = path.read_text()
         for m in _TIMELINE_COUNTER.finditer(text):
@@ -53,7 +67,11 @@ def scan() -> tuple[dict[str, set], set, list[str]]:
                 window if depth_end < 0 else window[:depth_end + 1]))
         for m in _FAULT_SITE.finditer(text):
             sites.add(m.group(1))
-    return activities, sites, problems
+        for m in _REGISTRY_METRIC.finditer(text):
+            if _ACTIVITY_NEXT.match(text, m.end()):
+                continue                 # a timeline.counter(track, "SCHED"
+            metric_names.add(m.group(2))
+    return activities, sites, metric_names, problems
 
 
 def main() -> int:
@@ -61,7 +79,7 @@ def main() -> int:
         sys.path.insert(0, str(REPO))
     from horovod_tpu import metrics
 
-    activities, sites, problems = scan()
+    activities, sites, metric_names, problems = scan()
 
     registered = set(metrics.TIMELINE_COUNTER_SERIES)
     for activity, keys in sorted(activities.items()):
@@ -91,6 +109,24 @@ def main() -> int:
             f"metrics.FAULT_SITES registers {site!r} but no "
             f"faults.check call uses it")
 
+    # Registry metric names (counter/gauge/histogram) vs METRIC_HELP,
+    # both directions.  Composed-name families (``"serve." + key`` over
+    # the LIFECYCLE series, ``"prefix." + key`` over the PREFIX series)
+    # have no literal call site, so their table entries are excused
+    # from the dead-entry check.
+    help_names = set(metrics.METRIC_HELP)
+    dynamic = (
+        {"serve." + k for k in metrics.TIMELINE_COUNTER_SERIES["LIFECYCLE"]}
+        | {"prefix." + k for k in metrics.TIMELINE_COUNTER_SERIES["PREFIX"]})
+    for name in sorted(metric_names - help_names):
+        problems.append(
+            f"registry metric {name!r} is emitted but has no "
+            f"metrics.METRIC_HELP entry (dashboards get no # HELP line)")
+    for name in sorted(help_names - metric_names - dynamic):
+        problems.append(
+            f"metrics.METRIC_HELP describes {name!r} but no "
+            f"counter/gauge/histogram call site emits it")
+
     # Internal consistency: the event-log replay map must cover exactly
     # the LIFECYCLE counter series (both are views of the same dict).
     lifecycle = set(metrics.TIMELINE_COUNTER_SERIES["LIFECYCLE"])
@@ -105,7 +141,8 @@ def main() -> int:
             print(f"check_counter_names: {p}")
         return 1
     print(f"check_counter_names: OK ({len(activities)} counter "
-          f"activities, {len(sites)} fault sites)")
+          f"activities, {len(sites)} fault sites, "
+          f"{len(metric_names)} registry metrics)")
     return 0
 
 

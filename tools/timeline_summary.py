@@ -6,6 +6,15 @@ quick look during a run shouldn't need a browser:
 
     python tools/timeline_summary.py /tmp/timeline.json [--top 20] [--json]
 
+Multi-rank merge (per-rank traces from a ``{rank}``-templated
+``maybe_create`` path): positional order assigns ranks 0, 1, ... —
+each event's ``pid`` becomes its rank (the original tensor pid moves to
+``tid``), so chrome://tracing shows one process lane per rank; summary
+and ``--json`` modes aggregate across the ranks, with tensors prefixed
+``r<k>/``:
+
+    python tools/timeline_summary.py --merge r0.json r1.json --out all.json
+
 Prints per-tensor negotiation and execution durations, per-phase totals,
 the negotiation tick counts per rank (NEGOTIATE_TICK_r<k> instants —
 reference timeline.cc:98-132 parity), aggregated counter (``ph: "C"``)
@@ -36,6 +45,57 @@ def load_events(path: str) -> list[dict]:
         data = json.loads(text.rstrip().rstrip(",") + "]")
     # Chrome trace is either a bare event array or {"traceEvents": [...]}.
     return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def merge_chrome(paths: list[str]) -> list[dict]:
+    """Stitch per-rank Chrome traces into ONE: rank k's events get
+    ``pid=k`` (one process lane per rank in chrome://tracing) and keep
+    their original tensor pid as ``tid``; the per-tensor
+    ``process_name`` metadata becomes per-rank ``thread_name`` rows and
+    each rank lane is labeled ``rank k``."""
+    out: list[dict] = []
+    for rank, path in enumerate(paths):
+        out.append({"name": "process_name", "ph": "M", "pid": rank,
+                    "args": {"name": f"rank {rank}"}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": rank,
+                    "args": {"sort_index": rank}})
+        for e in load_events(path):
+            orig_pid = e.get("pid", 0)
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    out.append({"name": "thread_name", "ph": "M",
+                                "pid": rank, "tid": orig_pid,
+                                "args": dict(e.get("args", {}))})
+                # drop other process-level metadata (sort indices etc.:
+                # they would re-order the rank lanes)
+                continue
+            e = dict(e)
+            e["pid"] = rank
+            # The tensor identity lives in the original pid (the writer
+            # emits a constant tid 0), so tid must be overwritten, not
+            # defaulted, to keep one thread row per tensor in the lane.
+            e["tid"] = orig_pid
+            out.append(e)
+    return out
+
+
+def merge_for_summary(paths: list[str]) -> list[dict]:
+    """Concatenate per-rank traces for :func:`summarize`, keeping pids
+    unique per (rank, tensor) — ``summarize`` pairs B/E by (pid, name),
+    so colliding tensor pids across ranks would cross-pair.  Tensor
+    names gain an ``r<k>/`` prefix; counter/instant/span names stay
+    shared so those series aggregate fleet-wide."""
+    out: list[dict] = []
+    for rank, path in enumerate(paths):
+        for e in load_events(path):
+            e = dict(e)
+            e["pid"] = rank * 1_000_000 + e.get("pid", 0)
+            if (e.get("ph") == "M" and e.get("name") == "process_name"
+                    and e.get("args")):
+                e["args"] = {**e["args"],
+                             "name": f"r{rank}/{e['args'].get('name', '')}"}
+            out.append(e)
+    return out
 
 
 def summarize(events: list[dict]) -> dict:
@@ -140,14 +200,33 @@ def summarize(events: list[dict]) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace")
+    ap.add_argument("trace", nargs="?",
+                    help="one Chrome-trace JSON (omit with --merge)")
+    ap.add_argument("--merge", nargs="+", metavar="RANK_TRACE",
+                    help="per-rank traces in rank order; summarized "
+                         "together (and stitched into --out)")
+    ap.add_argument("--out",
+                    help="with --merge: write the merged Chrome trace "
+                         "(pid=rank, tid=original tensor pid) here")
     ap.add_argument("--top", type=int, default=20,
                     help="show the N tensors with the largest total time")
     ap.add_argument("--json", action="store_true",
                     help="dump the full summary dict as JSON")
     args = ap.parse_args(argv)
 
-    s = summarize(load_events(args.trace))
+    if bool(args.trace) == bool(args.merge):
+        ap.error("give exactly one of: a trace path, or --merge")
+    if args.out and not args.merge:
+        ap.error("--out only makes sense with --merge")
+
+    if args.merge:
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merge_chrome(args.merge), f)
+        s = summarize(merge_for_summary(args.merge))
+        s["ranks"] = len(args.merge)
+    else:
+        s = summarize(load_events(args.trace))
     if args.json:
         print(json.dumps(s, indent=2, sort_keys=True))
         return 0
