@@ -1,0 +1,106 @@
+"""Canonical registry of every environment knob the package reads.
+
+``ENV_KNOBS`` is the single source of truth for the ``HOROVOD_*`` /
+``HVD_TPU_*`` configuration surface: one ``(name, default, help)`` row
+per knob.  The hvdlint HVD003 checker enforces membership both ways —
+every getenv site in the package must have a row here, every row must
+have a live read site, and the docs table in ``docs/observability.md``
+must match this table exactly (regenerate it with
+``python -m horovod_tpu.knobs``).
+
+The table MUST stay a pure literal: hvdlint extracts it by AST
+``literal_eval`` without importing this module (so the linter never
+pulls in jax).  Keep rows sorted by name; an empty default means
+"unset" (the reader treats absence and empty string the same).
+"""
+
+from __future__ import annotations
+
+import collections
+
+# name, default (as the env string; "" = unset), one-line help.
+ENV_KNOBS = (
+    ("HOROVOD_AUTOTUNE", "0",
+     "Enable online (fusion-threshold, cycle-time) autotuning."),
+    ("HOROVOD_AUTOTUNE_LOG", "",
+     "CSV file receiving one row per autotune sample."),
+    ("HOROVOD_AUTOTUNE_STEADY_STATE_SAMPLES", "10",
+     "Samples per tuning point after warmup before scoring it."),
+    ("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "3",
+     "Samples discarded after each knob change before measuring."),
+    ("HOROVOD_CYCLE_TIME", "5.0",
+     "Background dispatch-loop cycle time in milliseconds."),
+    ("HOROVOD_FUSION_THRESHOLD", "67108864",
+     "Tensor-fusion bucket size in bytes (64 MiB default)."),
+    ("HOROVOD_HIERARCHICAL_ALLREDUCE", "0",
+     "Two-level (intra-host reduce, inter-host allreduce) dispatch."),
+    ("HOROVOD_SPARSE_ALLREDUCE", "0",
+     "Gradient-sparsity-aware allreduce for IndexedSlices-style updates."),
+    ("HOROVOD_STALL_CHECK_DISABLE", "0",
+     "Disable the stalled-negotiation warning thread."),
+    ("HOROVOD_STALL_CHECK_TIME", "60.0",
+     "Seconds a rank may lag negotiation before a stall warning."),
+    ("HOROVOD_TIMELINE", "",
+     "Chrome-trace timeline output path (enables timeline recording)."),
+    ("HOROVOD_TPU_CONTROLLER_TRANSPORT", "",
+     "Native control-plane transport: tcp:<host>:<port> or local:<world>."),
+    ("HOROVOD_TPU_COORDINATOR", "",
+     "host:port of the rank-0 coordinator for multi-process init."),
+    ("HOROVOD_TPU_ELASTIC_RETRIES", "3",
+     "Elastic-training restarts allowed before giving up."),
+    ("HOROVOD_TPU_FORCE_PLATFORM", "",
+     "Force a jax platform (cpu/tpu) instead of auto-detection."),
+    ("HOROVOD_TPU_HIERARCHY_LOCAL_SIZE", "0",
+     "Inner mesh extent for hierarchical dispatch (0 = local devices)."),
+    ("HOROVOD_TPU_LOCAL_RANK", "",
+     "This process's rank within its host (launcher-provided)."),
+    ("HOROVOD_TPU_LOCAL_SIZE", "",
+     "Number of processes on this host (launcher-provided)."),
+    ("HOROVOD_TPU_NATIVE_CONTROLLER", "auto",
+     "Native coordination engine: auto, on, or off."),
+    ("HOROVOD_TPU_NUM_PROCESSES", "",
+     "World size for multi-process init (unset = single process)."),
+    ("HOROVOD_TPU_PROCESS_ID", "",
+     "This process's global rank (launcher-provided)."),
+    ("HOROVOD_TPU_SERIALIZE_DISPATCH", "auto",
+     "Depth-1 dispatch serialization: auto (CPU only), on, or off."),
+    ("HOROVOD_TPU_X64", "0",
+     "Enable 64-bit jax types for the torch-compat surface."),
+    ("HVD_TPU_BENCH_CACHE", "",
+     "Directory for cached benchmark baselines (default: repo-local)."),
+    ("HVD_TPU_EVENT_LOG", "",
+     "JSONL request-lifecycle event-log output path."),
+    ("HVD_TPU_FLASH_BWD", "pallas",
+     "Flash-attention backward implementation: pallas or blockwise."),
+    ("HVD_TPU_MONITOR_PORT", "",
+     "Port for the per-rank /metrics + /healthz HTTP exporter."),
+    ("HVD_TPU_NEGOTIATE_TIMEOUT_S", "60",
+     "Host-card negotiation deadline in seconds during init()."),
+    ("HVD_TPU_SLO_E2E_S", "0",
+     "End-to-end latency SLO in seconds for goodput (0 = no SLO)."),
+    ("HVD_TPU_STRAGGLER_WARN_S", "1.0",
+     "Step-lag threshold in seconds before a straggler warning."),
+    ("HVD_TPU_VERIFY_BLOCKS", "0",
+     "Walk paged-KV block tables every serve tick (debug, slow)."),
+)
+
+Knob = collections.namedtuple("Knob", ("name", "default", "help"))
+
+
+def knobs() -> tuple[Knob, ...]:
+    """The registry as named tuples, sorted by name."""
+    return tuple(Knob(*row) for row in ENV_KNOBS)
+
+
+def render_markdown_table() -> str:
+    """The docs/observability.md knob table (HVD003 lints the docs copy
+    against ``ENV_KNOBS``; paste this output verbatim on drift)."""
+    lines = ["| Knob | Default | Meaning |", "| --- | --- | --- |"]
+    for k in knobs():
+        default = f"`{k.default}`" if k.default else "*(unset)*"
+        lines.append(f"| `{k.name}` | {default} | {k.help} |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(render_markdown_table())

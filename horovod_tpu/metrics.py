@@ -157,6 +157,7 @@ class Counter:
     """A monotonically increasing integer (Prometheus ``counter``)."""
 
     __slots__ = ("name", "_lock", "_value")
+    _GUARDED_BY_LOCK = ("_value",)
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
@@ -179,6 +180,7 @@ class Gauge:
     """A last-value-wins float (Prometheus ``gauge``)."""
 
     __slots__ = ("name", "_lock", "_value")
+    _GUARDED_BY_LOCK = ("_value",)
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
@@ -245,6 +247,7 @@ class Histogram:
 
     __slots__ = ("name", "bounds", "_lock", "_counts", "_count", "_sum",
                  "_min", "_max")
+    _GUARDED_BY_LOCK = ("_counts", "_count", "_sum", "_min", "_max")
 
     def __init__(self, name: str, lock: threading.Lock,
                  bounds: tuple[float, ...] | None = None):
@@ -360,6 +363,8 @@ class EventLog:
     up to its last event (the postmortem property the engine watchdog
     counts on).  Thread-safe."""
 
+    _GUARDED_BY_LOCK = ("_file",)
+
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
@@ -455,6 +460,8 @@ class MetricsRegistry:
     env mid-process), ``None`` disables events, and an explicit
     :class:`EventLog` pins one.
     """
+
+    _GUARDED_BY_LOCK = ("_counters", "_gauges", "_histograms")
 
     def __init__(self, event_log: "EventLog | None | str" = "auto"):
         self._lock = threading.Lock()

@@ -343,12 +343,19 @@ class StragglerDetector:
     naming the slowest rank when the skew exceeds ``warn_s``
     (``HVD_TPU_STRAGGLER_WARN_S``, default 1.0)."""
 
+    # record_step arrives from the engine/training thread while the
+    # monitor thread calls report()/check() — the windows and the
+    # delta baseline are cross-thread state.
+    _GUARDED_BY_LOCK = ("_steps", "_negotiates",
+                        "_neg_seen_count", "_neg_seen_sum")
+
     def __init__(self, registry: metrics_mod.MetricsRegistry | None = None,
                  window: int = 64, warn_s: float | None = None):
         self.registry = (registry if registry is not None
                          else metrics_mod.DEFAULT)
         self.warn_s = (warn_s if warn_s is not None
                        else _env_float("HVD_TPU_STRAGGLER_WARN_S", 1.0))
+        self._lock = threading.Lock()
         self._steps: collections.deque[float] = collections.deque(
             maxlen=window)
         self._negotiates: collections.deque[float] = collections.deque(
@@ -358,16 +365,19 @@ class StragglerDetector:
         self._neg_seen_sum = 0.0
 
     def record_step(self, dt_s: float) -> None:
-        self._steps.append(float(dt_s))
+        with self._lock:
+            self._steps.append(float(dt_s))
         self.registry.histogram("hvd.step_s").observe(dt_s)
 
     def record_negotiate(self, dt_s: float) -> None:
-        self._negotiates.append(float(dt_s))
+        with self._lock:
+            self._negotiates.append(float(dt_s))
 
-    def _pull_negotiate_deltas(self) -> None:
+    def _pull_negotiate_deltas_locked(self) -> None:
         """Fold in whatever ``hvd.negotiate_s`` observed since the last
         check — the eager engine feeds that histogram on every
-        negotiated dispatch, so no extra plumbing is needed."""
+        negotiated dispatch, so no extra plumbing is needed.  Caller
+        holds ``self._lock`` (a plain Lock: re-taking it would wedge)."""
         h = self.registry.histogram("hvd.negotiate_s")
         count, total = h.count, h.sum
         dn = count - self._neg_seen_count
@@ -381,9 +391,10 @@ class StragglerDetector:
 
     def report(self) -> dict:
         """This rank's window summary (the unit ``check()`` gathers)."""
-        self._pull_negotiate_deltas()
-        steps = list(self._steps)
-        negs = list(self._negotiates)
+        with self._lock:
+            self._pull_negotiate_deltas_locked()
+            steps = list(self._steps)
+            negs = list(self._negotiates)
         return {
             "rank": metrics_mod.current_rank(),
             "n_steps": len(steps),
@@ -457,6 +468,8 @@ class SLOWindow:
     good (pure completion goodput).  ``goodput()`` is the good fraction
     of the last ``window`` terminal requests; ``report()`` adds windowed
     TTFT/TPOT/E2E percentiles."""
+
+    _GUARDED_BY_LOCK = ("_traces",)
 
     def __init__(self, window: int = 256, slo_e2e_s: float | None = None):
         if window <= 0:

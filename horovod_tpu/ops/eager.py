@@ -98,6 +98,20 @@ class EagerEngine:
     operations.cc:2011-2029).
     """
 
+    # `stats` is intentionally undeclared: it is mixed-lock by design
+    # (incremented under whichever lock the touching path already
+    # holds — see the comment above its assignment).
+    _GUARDED_BY_LOCK = {
+        "_lock": ("_queue", "_join_active", "_join_result"),
+        "_flush_lock": ("_submitted", "_dispatch_cache"),
+    }
+    # These run entirely under _flush_lock taken by flush()'s caller
+    # chain; they contain no `with` of their own.
+    _LOCK_HOLDER_METHODS = {
+        "_flush_lock": ("_flush_via_controller", "_allreduce_group_fn",
+                        "_dispatch_allreduce_group", "_dispatch_single"),
+    }
+
     def __init__(self, mesh, cfg, timeline=None):
         self.mesh = mesh
         self.config = cfg

@@ -32,6 +32,8 @@ class _Entry:
 
 
 class HandleManager:
+    _GUARDED_BY_LOCK = ("_entries",)
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counter = itertools.count()

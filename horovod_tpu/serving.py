@@ -323,6 +323,18 @@ class ContinuousBatcher:
         self._prefill_suffix = _prefill_suffix
         self._tick = _tick
 
+    def compile_cache_sizes(self) -> dict[str, int]:
+        """Compile counts of the batcher's device programs.  The decode
+        tick must hold ONE signature for the pool's life; prefill
+        programs are one per distinct padded prompt width (a multiple of
+        ``admit_width``).  Tests snapshot this dict and assert it stays
+        flat across steady-state serving."""
+        return {
+            "prefill_one": self._prefill_one._cache_size(),
+            "prefill_suffix": self._prefill_suffix._cache_size(),
+            "tick": self._tick._cache_size(),
+        }
+
     # -- admission ---------------------------------------------------------
 
     def free_slots(self) -> list[int]:
@@ -483,6 +495,7 @@ def _spec_programs(cfg: llama.LlamaConfig, draft_cfg: llama.LlamaConfig,
     repeated speculative_generate calls reuse one XLA compile (the same
     lifetime pattern as ContinuousBatcher's held closures)."""
 
+    # hvdlint: disable=HVD001 -- held by the lru_cache: one program per config triple
     @jax.jit
     def draft_round(dparams, dcache, first_tok):
         """draft_k proposals from first_tok, in draft_k + 1 decode steps:
@@ -502,6 +515,7 @@ def _spec_programs(cfg: llama.LlamaConfig, draft_cfg: llama.LlamaConfig,
             step, (first_tok, dcache), None, length=draft_k + 1)
         return jnp.moveaxis(drafts, 0, 1)[:, :draft_k], dcache
 
+    # hvdlint: disable=HVD001 -- held by the lru_cache: one program per config triple
     @jax.jit
     def verify_round(params_, tcache, chunk):
         logits, tcache = llama.decode_chunk(params_, chunk, cfg, tcache)

@@ -1,0 +1,33 @@
+"""HVD001 bad case: a jitted tick branching on a traced parameter.
+
+Exactly ONE finding: the `if temperature > 0.0` branch.  The jit is
+pinned through compile_cache_sizes, shape inspection is static, and the
+closure-variable branch in `_other` must NOT fire.
+"""
+from functools import partial
+
+import jax
+
+
+class Engine:
+    def __init__(self, scale):
+        @partial(jax.jit, donate_argnums=(0,))
+        def _tick(state, tok, temperature):
+            if state.shape[0] > 4:          # static: shape inspection
+                tok = tok + 1
+            if temperature > 0.0:           # BAD: traced-parameter branch
+                tok = tok * 2
+            return state, tok
+
+        @jax.jit
+        def _other(state):
+            if scale > 0:                   # closure var: trace-time const
+                state = state + scale
+            return state
+
+        self._tick = _tick
+        self._other = _other
+
+    def compile_cache_sizes(self):
+        return {"tick": self._tick._cache_size(),
+                "other": self._other._cache_size()}

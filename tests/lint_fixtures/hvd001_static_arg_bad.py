@@ -1,0 +1,22 @@
+"""HVD001 bad case: a list literal passed in a static_argnums position
+— static args are hashed as compile-cache keys, so this raises (or
+retraces per value once tupled ad hoc).  Exactly ONE finding (the call
+site); the jit itself is pinned."""
+from functools import partial
+
+import jax
+
+
+class Engine:
+    def __init__(self):
+        @partial(jax.jit, static_argnums=(1,))
+        def _run(state, dims):
+            return state.reshape(dims)
+
+        self._run = _run
+
+    def compile_cache_sizes(self):
+        return {"run": self._run._cache_size()}
+
+    def step(self, state):
+        return self._run(state, [4, 4])     # BAD: unhashable static arg

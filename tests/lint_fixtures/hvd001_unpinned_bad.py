@@ -1,0 +1,13 @@
+"""HVD001 bad case: a jitted function bound to self with no
+compile_cache_sizes pin.  Exactly ONE finding (the binding); the body
+has no traced branches."""
+import jax
+
+
+class Engine:
+    def __init__(self):
+        @jax.jit
+        def _tick(state):
+            return state + 1
+
+        self._tick = _tick

@@ -1,0 +1,25 @@
+"""HVD002 bad case: a guarded attribute mutated outside the lock.
+Exactly ONE finding: the unguarded `append` in `record`.  The guarded
+mutation in `drain`, the `_locked` helper, and construction in
+`__init__` are all fine."""
+import threading
+
+
+class Window:
+    _GUARDED_BY_LOCK = ("_items",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def record(self, x):
+        self._items.append(x)          # BAD: no lock held
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)
+            self._items = []
+        return out
+
+    def _merge_locked(self, other):
+        self._items.extend(other)      # fine: *_locked convention

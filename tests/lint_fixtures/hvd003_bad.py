@@ -1,0 +1,9 @@
+"""HVD003 bad case: a getenv of a knob missing from ENV_KNOBS.
+Exactly ONE finding when linted with a table that registers (and
+documents) HVD_TPU_KNOWN but not HVD_TPU_ROGUE_KNOB; the non-prefixed
+read is out of scope."""
+import os
+
+_KNOWN = os.environ.get("HVD_TPU_KNOWN", "1")
+_ROGUE = os.environ.get("HVD_TPU_ROGUE_KNOB")      # BAD: unregistered
+_OTHER = os.environ.get("SOME_OTHER_VAR")          # out of scope

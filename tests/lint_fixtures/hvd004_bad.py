@@ -1,0 +1,10 @@
+"""HVD004 bad case: fault sites for a synthetic project registering
+("serve.tick", "untested.site").  Both have injection call sites here,
+but the synthetic test file only references serve.tick — exactly ONE
+finding (untested.site:no-test-reference)."""
+
+
+def tick(faults, engine):
+    faults.check("serve.tick", key="r1")
+    faults.check("untested.site", key="r1")   # BAD: no test reference
+    return engine

@@ -1,0 +1,8 @@
+"""HVD005 bad case: a registry metric emitted with no METRIC_HELP
+entry.  Exactly ONE finding when linted with a metric_help table that
+knows `good.metric` but not `rogue.metric`."""
+
+
+def emit(registry):
+    registry.counter("good.metric").inc()
+    registry.counter("rogue.metric").inc()     # BAD: no # HELP entry

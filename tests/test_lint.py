@@ -1,0 +1,370 @@
+"""hvdlint: the invariant linter runs as part of the suite.
+
+Two layers of pinning:
+
+1. *Checker behavior*: each code (HVD001–HVD005) fires exactly once on
+   its known-bad fixture (tests/lint_fixtures/) built into a tiny
+   synthetic project — and NOT on the adjacent good patterns in the
+   same fixture (static shape branches, `_locked` helpers, lock-held
+   mutations, out-of-scope env vars).  Suppressions need their
+   mandatory justification; the baseline grandfathers by fingerprint
+   and flags stale entries.
+2. *The repo itself is clean*: ``run_lint`` over the real tree has zero
+   active findings and zero stale baseline entries — i.e. the
+   committed baseline is minimal and every convention the checkers
+   encode actually holds.  This is the gate that keeps the serving
+   stack's retrace/lock/name invariants machine-checked from here on.
+
+Stdlib-only: no jax import anywhere on this path (the linter parses
+the package, never imports it), so the whole module is tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.hvdlint import CODES, Project, all_checkers, run_lint  # noqa: E402
+from tools.hvdlint.checkers.hvd001_retrace import RetraceChecker  # noqa: E402
+from tools.hvdlint.checkers.hvd002_locks import (  # noqa: E402
+    LockDisciplineChecker,
+)
+from tools.hvdlint.checkers.hvd003_env_knobs import (  # noqa: E402
+    EnvKnobChecker,
+)
+from tools.hvdlint.checkers.hvd004_fault_sites import (  # noqa: E402
+    FaultSiteChecker,
+)
+from tools.hvdlint.checkers.hvd005_names import (  # noqa: E402
+    CounterNameChecker,
+)
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+pytestmark = pytest.mark.lint
+
+
+def make_project(tmp_path, fixture_names, *, test_sources=(), **overrides):
+    """A synthetic project: fixtures copied into ``pkg/``, optional
+    synthetic test files, canonical tables passed as overrides."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    for name in fixture_names:
+        shutil.copy(FIXTURES / name, pkg / name)
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    for i, src in enumerate(test_sources):
+        (tdir / f"test_synth_{i}.py").write_text(src)
+    return Project(tmp_path, package_dirs=("pkg",), **overrides)
+
+
+def lint(project, checker):
+    return run_lint(project=project, checkers=[checker], baseline=None)
+
+
+# ---------------------------------------------------------------------------
+# Per-checker bad fixtures: each code fires exactly once.
+# ---------------------------------------------------------------------------
+
+
+def test_hvd001_branch_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd001_branch_bad.py"],
+                        hvd001_targets=("pkg/hvd001_branch_bad.py",))
+    res = lint(proj, RetraceChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    f = res.active[0]
+    assert f.code == "HVD001"
+    assert "branch:temperature" in f.symbol
+
+
+def test_hvd001_unpinned_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd001_unpinned_bad.py"],
+                        hvd001_targets=("pkg/hvd001_unpinned_bad.py",))
+    res = lint(proj, RetraceChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert res.active[0].symbol == "Engine._tick:unpinned"
+
+
+def test_hvd001_static_arg_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd001_static_arg_bad.py"],
+                        hvd001_targets=("pkg/hvd001_static_arg_bad.py",))
+    res = lint(proj, RetraceChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert "static-arg-1" in res.active[0].symbol
+
+
+def test_hvd002_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd002_bad.py"],
+                        hvd002_strict_files=("pkg/hvd002_bad.py",))
+    res = lint(proj, LockDisciplineChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert res.active[0].symbol == "Window.record._items"
+
+
+def test_hvd002_undeclared_lock_in_strict_file(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._data = {}\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=("pkg/mod.py",))
+    res = lint(proj, LockDisciplineChecker)
+    assert len(res.active) == 1
+    assert res.active[0].symbol == "C:undeclared"
+    # ...and the same class outside the strict list is left alone
+    proj2 = Project(tmp_path, package_dirs=("pkg",),
+                    hvd002_strict_files=())
+    assert lint(proj2, LockDisciplineChecker).active == []
+
+
+def test_hvd002_stale_declaration(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class C:\n"
+        "    _GUARDED_BY_LOCK = (\"_gone\",)\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = lint(proj, LockDisciplineChecker)
+    assert [f.symbol for f in res.active] == ["C._gone:stale-declaration"]
+
+
+def test_hvd003_fires_once(tmp_path):
+    docs = tmp_path / "docs.md"
+    docs.write_text("| Knob | Default | Meaning |\n| --- | --- | --- |\n"
+                    "| `HVD_TPU_KNOWN` | `1` | A registered knob. |\n")
+    proj = make_project(
+        tmp_path, ["hvd003_bad.py"],
+        env_knobs=(("HVD_TPU_KNOWN", "1", "A registered knob."),),
+        docs_knobs_file="docs.md")
+    res = lint(proj, EnvKnobChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert res.active[0].symbol == "HVD_TPU_ROGUE_KNOB:unregistered"
+
+
+def test_hvd003_dead_and_undocumented_rows(tmp_path):
+    docs = tmp_path / "docs.md"
+    docs.write_text("| `HVD_TPU_KNOWN` | `1` | x |\n"
+                    "| `HVD_TPU_GHOST` | `0` | stale docs row |\n")
+    proj = make_project(
+        tmp_path, ["hvd003_bad.py"],
+        env_knobs=(("HVD_TPU_KNOWN", "1", "x"),
+                   ("HVD_TPU_ROGUE_KNOB", "", "now registered"),
+                   ("HVD_TPU_NEVER_READ", "", "dead entry")),
+        docs_knobs_file="docs.md")
+    res = lint(proj, EnvKnobChecker)
+    symbols = sorted(f.symbol for f in res.active)
+    assert symbols == ["HVD_TPU_GHOST:stale-docs",
+                       "HVD_TPU_NEVER_READ:dead-entry",
+                       "HVD_TPU_NEVER_READ:undocumented",
+                       "HVD_TPU_ROGUE_KNOB:undocumented"]
+
+
+def test_hvd004_fires_once(tmp_path):
+    proj = make_project(
+        tmp_path, ["hvd004_bad.py"],
+        test_sources=['SITE = "serve.tick"\n'],
+        fault_sites=("serve.tick", "untested.site"))
+    res = lint(proj, FaultSiteChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert res.active[0].symbol == "untested.site:no-test-reference"
+
+
+def test_hvd004_unregistered_and_dead_site(tmp_path):
+    proj = make_project(
+        tmp_path, ["hvd004_bad.py"],
+        test_sources=['A = "serve.tick"; B = "untested.site"; '
+                      'C = "ghost.site"\n'],
+        fault_sites=("serve.tick", "untested.site", "ghost.site"))
+    res = lint(proj, FaultSiteChecker)
+    assert sorted(f.symbol for f in res.active) == [
+        "ghost.site:no-injection-site"]
+
+
+def test_hvd005_fires_once(tmp_path):
+    proj = make_project(
+        tmp_path, ["hvd005_bad.py"],
+        metric_help={"good.metric": "a described metric"},
+        timeline_counter_series={}, lifecycle_event_counters={})
+    res = lint(proj, CounterNameChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    assert res.active[0].symbol == "rogue.metric:no-help"
+
+
+# ---------------------------------------------------------------------------
+# Suppressions and the baseline.
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_justification(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class C:\n"
+        "    _GUARDED_BY_LOCK = (\"_data\",)\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._data = []\n"
+        "    def fast_path(self):\n"
+        "        # hvdlint: disable=HVD002 -- single-writer by design\n"
+        "        self._data.append(1)\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = lint(proj, LockDisciplineChecker)
+    assert res.active == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].code == "HVD002"
+
+
+def test_suppression_without_justification_is_a_finding(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class C:\n"
+        "    _GUARDED_BY_LOCK = (\"_data\",)\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._data = []\n"
+        "    def fast_path(self):\n"
+        "        self._data.append(1)  # hvdlint: disable=HVD002\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = lint(proj, LockDisciplineChecker)
+    codes = sorted(f.code for f in res.active)
+    # the bare suppression suppresses nothing AND is itself flagged
+    assert codes == ["HVD000", "HVD002"]
+
+
+def test_unused_suppression_is_reported(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "# hvdlint: disable=HVD002 -- nothing here needs this\n"
+        "X = 1\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = lint(proj, LockDisciplineChecker)
+    assert res.active == []
+    assert len(res.unused_suppressions) == 1
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(FIXTURES / "hvd002_bad.py", pkg / "hvd002_bad.py")
+    (tmp_path / "tests").mkdir()
+    baseline = tmp_path / "baseline.json"
+    fp = "HVD002:pkg/hvd002_bad.py:Window.record._items"
+    baseline.write_text(json.dumps({"version": 1, "findings": [
+        {"fingerprint": fp, "code": "HVD002",
+         "justification": "grandfathered for the test"}]}))
+
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = run_lint(project=proj, checkers=[LockDisciplineChecker],
+                   baseline=baseline)
+    assert res.ok
+    assert [f.fingerprint for f in res.baselined] == [fp]
+
+    # fix the finding -> the entry is stale and fails the run
+    (pkg / "hvd002_bad.py").write_text("X = 1\n")
+    proj2 = Project(tmp_path, package_dirs=("pkg",),
+                    hvd002_strict_files=())
+    res2 = run_lint(project=proj2, checkers=[LockDisciplineChecker],
+                    baseline=baseline)
+    assert not res2.ok
+    assert [e["fingerprint"] for e in res2.stale_baseline] == [fp]
+
+
+def test_baseline_todo_justification_does_not_grandfather(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(FIXTURES / "hvd002_bad.py", pkg / "hvd002_bad.py")
+    (tmp_path / "tests").mkdir()
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "findings": [
+        {"fingerprint": "HVD002:pkg/hvd002_bad.py:Window.record._items",
+         "code": "HVD002", "justification": "TODO: fill me in"}]}))
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = run_lint(project=proj, checkers=[LockDisciplineChecker],
+                   baseline=baseline)
+    assert not res.ok                   # finding stays active
+    assert len(res.active) == 1
+    assert len(res.stale_baseline) == 1  # and the entry reads as stale
+
+
+def test_unparsable_file_is_hvd000(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def oops(:\n")
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd002_strict_files=())
+    res = run_lint(project=proj, checkers=[], baseline=None)
+    assert [f.code for f in res.active] == ["HVD000"]
+
+
+# ---------------------------------------------------------------------------
+# The real repo is clean, and the plumbing holds together.
+# ---------------------------------------------------------------------------
+
+
+def test_all_five_checkers_registered():
+    codes = {c.code for c in all_checkers()}
+    assert codes == {"HVD001", "HVD002", "HVD003", "HVD004", "HVD005"}
+    assert set(CODES) >= codes | {"HVD000"}
+
+
+def test_repo_is_clean_and_baseline_minimal():
+    """The gate: zero active findings on the real tree, zero stale
+    baseline entries (the committed baseline is minimal), and every
+    suppression in the tree is actually used."""
+    res = run_lint(REPO_ROOT)
+    assert res.active == [], "\n".join(f.render() for f in res.active)
+    assert res.stale_baseline == [], res.stale_baseline
+    assert res.unused_suppressions == [], [
+        (s.path, s.line) for s in res.unused_suppressions]
+
+
+def test_cli_json_schema():
+    """`python -m tools.hvdlint --json` exits 0 on the repo and emits
+    the documented schema."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["version"] == 1
+    assert data["summary"]["ok"] is True
+    assert data["summary"]["active"] == 0
+    assert {"code", "path", "line", "message", "fingerprint", "status"} \
+        <= set(data["findings"][0]) if data["findings"] else True
+    assert "HVD001" in data["codes"] and "HVD005" in data["codes"]
+
+
+def test_cli_list_codes():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--list-codes"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    for code in ("HVD000", "HVD001", "HVD002", "HVD003", "HVD004",
+                 "HVD005"):
+        assert code in out.stdout

@@ -1,0 +1,4 @@
+"""Repo tooling namespace — makes ``python -m tools.hvdlint`` work from
+a checkout root.  Scripts in this directory that predate the package
+(``tools/timeline_summary.py`` and friends) are still plain scripts and
+do not import through this namespace."""

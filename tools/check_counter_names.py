@@ -1,148 +1,40 @@
 """Lint: every observability name in the code is in the canonical tables.
 
-Dashboards and the timeline-summary tool key on four name families —
-Chrome-trace counter activities (``timeline.counter("track", "SCHED",
-{...})``), fault-injection sites (``faults.check("serve.tick", ...)``),
-the event-log lifecycle kinds, and registry metric names
-(``metrics.counter("monitor.scrapes")`` / ``hvd.step_*`` /
-``serve.goodput`` ...) — all declared once in
-:mod:`horovod_tpu.metrics` (``TIMELINE_COUNTER_SERIES``,
-``FAULT_SITES``, ``LIFECYCLE_EVENT_COUNTERS``, ``METRIC_HELP``).
-This tool greps the
-package source for actual call sites and asserts membership BOTH ways:
-an unregistered name in code fails (a dashboard would silently miss
-it), and a registered name with no call site fails (dead table entries
-rot).  Run directly or via the test suite (tests/test_metrics.py):
+Legacy entry point, kept for existing invocations and the
+`tests/test_metrics.py` driver — the actual checks moved into the
+hvdlint framework (`tools/hvdlint/`): counter/metric/lifecycle names
+are HVD005, fault-site membership is HVD004.  This shim runs exactly
+those two checkers over the repo and keeps the old exit contract
+(0 clean, 1 problems, one line per problem on stdout).  Prefer:
 
-    python tools/check_counter_names.py
+    python -m tools.hvdlint
+
+which runs the full suite (see docs/lint.md).
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "horovod_tpu"
-
-# timeline.counter("<track>", "<ACTIVITY>", {...}) — the uppercase
-# second string argument is what distinguishes a Chrome-trace counter
-# emission from MetricsRegistry.counter(name) lookups.
-_TIMELINE_COUNTER = re.compile(
-    r"\.counter\(\s*[\"']([^\"']+)[\"']\s*,\s*[\"']([A-Z][A-Z_]*)[\"']")
-# dict-literal series keys directly following the activity argument
-_SERIES_KEY = re.compile(r"[\"']([a-z_]+)[\"']\s*:")
-# faults.check("<site>", ...) — sites are dotted lowercase names
-_FAULT_SITE = re.compile(r"\.check\(\s*[\"']([a-z0-9_.]+)[\"']")
-# registry.counter/gauge/histogram("<name>"...) with a LITERAL name —
-# the closing quote must be followed by `,` or `)` so composed names
-# ("serve." + key) and f-strings stay out of scope (their families are
-# covered by table entries directly).
-_REGISTRY_METRIC = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[\"']([a-z0-9_.]+)[\"']\s*[,)]")
-# a timeline.counter first argument looks identical up to the comma;
-# disambiguate by what FOLLOWS: an uppercase activity string literal.
-_ACTIVITY_NEXT = re.compile(r"\s*[\"'][A-Z]")
-
-
-def scan() -> tuple[dict[str, set], set, set, list[str]]:
-    """Walk the package source; returns (activity -> literal series
-    keys seen), the fault sites seen, the literal registry metric
-    names seen, and any per-site problems."""
-    problems: list[str] = []
-    activities: dict[str, set] = {}
-    sites: set = set()
-    metric_names: set = set()
-    for path in sorted(PKG.rglob("*.py")):
-        text = path.read_text()
-        for m in _TIMELINE_COUNTER.finditer(text):
-            activity = m.group(2)
-            keys = activities.setdefault(activity, set())
-            # Only dict *literals* contribute keys (dict(self.counters)
-            # style emissions are covered by the table itself).
-            window = text[m.end():m.end() + 400]
-            depth_end = window.find(")")
-            keys.update(_SERIES_KEY.findall(
-                window if depth_end < 0 else window[:depth_end + 1]))
-        for m in _FAULT_SITE.finditer(text):
-            sites.add(m.group(1))
-        for m in _REGISTRY_METRIC.finditer(text):
-            if _ACTIVITY_NEXT.match(text, m.end()):
-                continue                 # a timeline.counter(track, "SCHED"
-            metric_names.add(m.group(2))
-    return activities, sites, metric_names, problems
 
 
 def main() -> int:
     if str(REPO) not in sys.path:      # direct `python tools/...` runs
         sys.path.insert(0, str(REPO))
-    from horovod_tpu import metrics
+    from tools.hvdlint import core
+    from tools.hvdlint.checkers.hvd004_fault_sites import FaultSiteChecker
+    from tools.hvdlint.checkers.hvd005_names import CounterNameChecker
 
-    activities, sites, metric_names, problems = scan()
-
-    registered = set(metrics.TIMELINE_COUNTER_SERIES)
-    for activity, keys in sorted(activities.items()):
-        if activity not in registered:
-            problems.append(
-                f"timeline counter activity {activity!r} is emitted but "
-                f"not registered in metrics.TIMELINE_COUNTER_SERIES")
-            continue
-        extra = keys - set(metrics.TIMELINE_COUNTER_SERIES[activity])
-        if extra:
-            problems.append(
-                f"timeline counter {activity!r} emits series "
-                f"{sorted(extra)} not registered in "
-                f"metrics.TIMELINE_COUNTER_SERIES[{activity!r}]")
-    for activity in sorted(registered - set(activities)):
-        problems.append(
-            f"metrics.TIMELINE_COUNTER_SERIES registers {activity!r} "
-            f"but no timeline.counter call emits it")
-
-    registered_sites = set(metrics.FAULT_SITES)
-    for site in sorted(sites - registered_sites):
-        problems.append(
-            f"fault site {site!r} is checked but not registered in "
-            f"metrics.FAULT_SITES")
-    for site in sorted(registered_sites - sites):
-        problems.append(
-            f"metrics.FAULT_SITES registers {site!r} but no "
-            f"faults.check call uses it")
-
-    # Registry metric names (counter/gauge/histogram) vs METRIC_HELP,
-    # both directions.  Composed-name families (``"serve." + key`` over
-    # the LIFECYCLE series, ``"prefix." + key`` over the PREFIX series)
-    # have no literal call site, so their table entries are excused
-    # from the dead-entry check.
-    help_names = set(metrics.METRIC_HELP)
-    dynamic = (
-        {"serve." + k for k in metrics.TIMELINE_COUNTER_SERIES["LIFECYCLE"]}
-        | {"prefix." + k for k in metrics.TIMELINE_COUNTER_SERIES["PREFIX"]})
-    for name in sorted(metric_names - help_names):
-        problems.append(
-            f"registry metric {name!r} is emitted but has no "
-            f"metrics.METRIC_HELP entry (dashboards get no # HELP line)")
-    for name in sorted(help_names - metric_names - dynamic):
-        problems.append(
-            f"metrics.METRIC_HELP describes {name!r} but no "
-            f"counter/gauge/histogram call site emits it")
-
-    # Internal consistency: the event-log replay map must cover exactly
-    # the LIFECYCLE counter series (both are views of the same dict).
-    lifecycle = set(metrics.TIMELINE_COUNTER_SERIES["LIFECYCLE"])
-    mapped = set(metrics.LIFECYCLE_EVENT_COUNTERS.values())
-    if lifecycle != mapped:
-        problems.append(
-            f"LIFECYCLE_EVENT_COUNTERS values {sorted(mapped)} != "
-            f"LIFECYCLE series {sorted(lifecycle)}")
-
-    if problems:
-        for p in problems:
-            print(f"check_counter_names: {p}")
+    result = core.run_lint(
+        REPO, checkers=(FaultSiteChecker, CounterNameChecker))
+    for f in result.active:
+        print(f"check_counter_names: {f.render()}")
+    if result.active:
         return 1
-    print(f"check_counter_names: OK ({len(activities)} counter "
-          f"activities, {len(sites)} fault sites, "
-          f"{len(metric_names)} registry metrics)")
+    print(f"check_counter_names: OK (via hvdlint HVD004+HVD005, "
+          f"{result.files_scanned} files)")
     return 0
 
 

@@ -1,0 +1,19 @@
+"""hvdlint — AST-based invariant linter for the horovod_tpu serving
+stack (retrace hazards, lock discipline, env knobs, fault-site and
+counter-name coverage).
+
+Public surface: :func:`run_lint`, :class:`Project`, :class:`Finding`,
+:class:`Checker`, :func:`register`, :data:`CODES`.  See docs/lint.md.
+"""
+
+from tools.hvdlint.core import (  # noqa: F401
+    CODES,
+    Checker,
+    Finding,
+    LintResult,
+    Project,
+    all_checkers,
+    find_repo_root,
+    register,
+    run_lint,
+)

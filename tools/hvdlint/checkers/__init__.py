@@ -1,0 +1,12 @@
+"""Built-in hvdlint checkers.  Importing this package registers every
+``hvdNNN_*`` module with the core registry; third-party checkers can do
+the same by importing :func:`tools.hvdlint.register` and decorating a
+:class:`~tools.hvdlint.Checker` subclass."""
+
+from tools.hvdlint.checkers import (  # noqa: F401
+    hvd001_retrace,
+    hvd002_locks,
+    hvd003_env_knobs,
+    hvd004_fault_sites,
+    hvd005_names,
+)

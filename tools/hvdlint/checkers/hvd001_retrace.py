@@ -1,0 +1,356 @@
+"""HVD001 — retrace hazards on the serving decode path.
+
+The engine's core invariant is *one jit signature per program for the
+server's life* (every retrace is a multi-second stall mid-decode).
+Three things break it statically:
+
+* **branch** — a jitted function branching (``if``/``while``) on one of
+  its traced parameters: under trace that raises
+  ``TracerBoolConversionError`` or, with the parameter later made
+  static, silently forks one compiled program per value.  Shape/dtype
+  inspection (``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``,
+  ``len(x)``, ``isinstance(x, ...)``) is static and exempt, as are
+  parameters declared in ``static_argnums``/``static_argnames``.
+* **unpinned** — a jit site whose compile count is not observable
+  through a ``compile_cache_sizes()`` method (the convention the serve
+  tests assert stays flat).  A jitted function bound to ``self.X`` is
+  pinned when the owning class's ``compile_cache_sizes`` reads
+  ``self.X._cache_size()``; module- or function-level jits have no pin
+  and are flagged for an explicit suppression/baseline decision.
+* **unhashable-static** — a call to a locally-jitted function passing a
+  list/dict/set literal in a static position: static argument values
+  are hashed as cache keys, so this raises at runtime (or, once
+  "fixed" by tupling per call site, retraces per distinct value).
+
+Scoped to the decode-path files (``serving_scheduler.py``,
+``models/llama.py``, ``serving.py``) — override with
+``Project(hvd001_targets=...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance"}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    """``jit`` or ``jax.jit`` as an expression."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _is_partial_name(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    return (isinstance(node, ast.Attribute) and node.attr == "partial"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "functools")
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The jit ``Call`` node when ``node`` is ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)`` (keywords ride on the same call)."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_name(node.func):
+        return node
+    if _is_partial_name(node.func) and node.args \
+            and _is_jit_name(node.args[0]):
+        return node
+    return None
+
+
+def _decorator_jit(dec: ast.AST) -> ast.Call | None | bool:
+    """True for bare ``@jax.jit``, the Call for ``@jax.jit(...)`` /
+    ``@partial(jax.jit, ...)``, None otherwise."""
+    if _is_jit_name(dec):
+        return True
+    return _jit_call(dec)
+
+
+def _static_params(fn: ast.FunctionDef, jit: ast.Call | bool) -> set[str]:
+    """Parameter names excluded from tracing by static_argnums/names."""
+    names: set[str] = set()
+    if jit is True or not isinstance(jit, ast.Call):
+        return names
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in jit.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except ValueError:
+            continue
+        if kw.arg == "static_argnums":
+            for i in val if isinstance(val, (tuple, list)) else (val,):
+                if isinstance(i, int) and 0 <= i < len(params):
+                    names.add(params[i])
+        elif kw.arg == "static_argnames":
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            names.update(v for v in vals if isinstance(v, str))
+    return names
+
+
+def _static_positions(jit: ast.Call | bool) -> tuple[set[int], set[str]]:
+    """(static positional indices, static keyword names) of a jit call."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    if not isinstance(jit, ast.Call):
+        return nums, names
+    for kw in jit.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except ValueError:
+            continue
+        if kw.arg == "static_argnums":
+            nums.update(i for i in
+                        (val if isinstance(val, (tuple, list)) else (val,))
+                        if isinstance(i, int))
+        elif kw.arg == "static_argnames":
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            names.update(v for v in vals if isinstance(v, str))
+    return nums, names
+
+
+def _traced_names(expr: ast.AST) -> set[str]:
+    """Names an expression's *value* depends on, excluding statically
+    evaluable contexts (shape/dtype attributes, len(), isinstance())."""
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return set()
+        return _traced_names(expr.value)
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Name) and \
+                expr.func.id in _STATIC_CALLS:
+            return set()
+        out = set()
+        for a in expr.args:
+            out |= _traced_names(a)
+        for kw in expr.keywords:
+            out |= _traced_names(kw.value)
+        return out
+    out = set()
+    for child in ast.iter_child_nodes(expr):
+        out |= _traced_names(child)
+    return out
+
+
+class _JitDef:
+    """One jitted function definition found in a file."""
+
+    def __init__(self, fn: ast.FunctionDef, jit: ast.Call | bool,
+                 qualname: str):
+        self.fn = fn
+        self.jit = jit
+        self.qualname = qualname
+        self.static = _static_params(fn, jit)
+
+    @property
+    def anchor(self) -> int:
+        """The decorator line, so a suppression comment directly above
+        the ``@jax.jit`` matches (findings match on their line or the
+        line above)."""
+        if self.fn.decorator_list:
+            return min(d.lineno for d in self.fn.decorator_list)
+        return self.fn.lineno
+
+
+@register
+class RetraceChecker(Checker):
+    code = "HVD001"
+    summary = ("retrace hazard: traced-parameter branch, jit not pinned "
+               "by compile_cache_sizes, or unhashable static argument")
+
+    DEFAULT_TARGETS = (
+        "horovod_tpu/serving_scheduler.py",
+        "horovod_tpu/models/llama.py",
+        "horovod_tpu/serving.py",
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        targets = (project.hvd001_targets
+                   if project.hvd001_targets is not None
+                   else self.DEFAULT_TARGETS)
+        for sf in project.files:
+            if sf.rel not in targets or sf.tree is None:
+                continue
+            yield from self._check_file(sf.rel, sf.tree)
+
+    # -- per-file ----------------------------------------------------------
+
+    def _check_file(self, rel: str, tree: ast.AST) -> Iterator[Finding]:
+        jit_defs: list[_JitDef] = []
+        # jit-expression assignments outside classes: (line, target text,
+        # enclosing qualname)
+        loose_assigns: list[tuple[int, str, str]] = []
+        pinned: set[str] = set()         # "ClassName.attr" pins
+        bound: dict[str, tuple[str, int]] = {}   # defname -> (Cls.attr, line)
+        class_of: dict[str, str | None] = {}     # def qualname -> class
+
+        def visit(node: ast.AST, qual: str, cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    jit = None
+                    for dec in child.decorator_list:
+                        jit = _decorator_jit(dec)
+                        if jit:
+                            break
+                    if jit:
+                        jd = _JitDef(child, jit, q)
+                        jit_defs.append(jd)
+                        class_of[q] = cls
+                    visit(child, q, cls)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    visit(child, q, child.name)
+                elif isinstance(child, ast.Assign) and cls is not None:
+                    self._class_assign(child, cls, qual, jit_defs, bound,
+                                       loose_assigns)
+                    visit(child, qual, cls)
+                elif isinstance(child, ast.Assign):
+                    if _jit_call(child.value) is not None:
+                        tgt = ast.unparse(child.targets[0])
+                        loose_assigns.append(
+                            (child.lineno, tgt, qual or "<module>"))
+                    visit(child, qual, cls)
+                else:
+                    visit(child, qual, cls)
+
+        visit(tree, "", None)
+
+        # Pins: compile_cache_sizes methods reading self.X._cache_size().
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and \
+                            item.name == "compile_cache_sizes":
+                        for sub in ast.walk(item):
+                            if (isinstance(sub, ast.Attribute)
+                                    and sub.attr == "_cache_size"
+                                    and isinstance(sub.value, ast.Attribute)
+                                    and isinstance(sub.value.value, ast.Name)
+                                    and sub.value.value.id == "self"):
+                                pinned.add(f"{node.name}.{sub.value.attr}")
+
+        # Rule: traced-parameter branches.
+        for jd in jit_defs:
+            yield from self._branches(rel, jd)
+
+        # Rule: unpinned jits.
+        for jd in jit_defs:
+            key = jd.fn.name if class_of.get(jd.qualname) else None
+            binding = bound.get(jd.fn.name) if key else None
+            if binding is not None:
+                attr, line = binding
+                if attr not in pinned:
+                    yield Finding(
+                        self.code, rel, line,
+                        f"jitted function bound to self.{attr.split('.')[1]}"
+                        f" is not pinned: add it to "
+                        f"{attr.split('.')[0]}.compile_cache_sizes() so "
+                        "retraces are observable",
+                        symbol=f"{attr}:unpinned")
+            else:
+                yield Finding(
+                    self.code, rel, jd.anchor,
+                    f"jit site `{jd.qualname}` is not pinned through any "
+                    "compile_cache_sizes(); suppress with a justification "
+                    "or bind it to a pinned class attribute",
+                    symbol=f"{jd.qualname}:unpinned")
+        for line, tgt, qual in loose_assigns:
+            yield Finding(
+                self.code, rel, line,
+                f"jit call assigned to `{tgt}` in {qual} is not pinned "
+                "through any compile_cache_sizes(); suppress with a "
+                "justification or bind it to a pinned class attribute",
+                symbol=f"{qual}:{tgt}:unpinned")
+
+        # Rule: unhashable literals in static positions at call sites.
+        by_name = {jd.fn.name: jd for jd in jit_defs}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                callee = node.func.attr
+            jd = by_name.get(callee or "")
+            if jd is None:
+                continue
+            nums, names = _static_positions(jd.jit)
+            params = [a.arg for a in jd.fn.args.posonlyargs
+                      + jd.fn.args.args]
+            for i, arg in enumerate(node.args):
+                name = params[i] if i < len(params) else None
+                if (i in nums or (name and name in jd.static)) and \
+                        isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    yield Finding(
+                        self.code, rel, node.lineno,
+                        f"unhashable {type(arg).__name__.lower()} literal "
+                        f"passed in static position {i} of jitted "
+                        f"`{jd.qualname}` — static args are hashed as "
+                        "compile-cache keys",
+                        symbol=f"{jd.qualname}:static-arg-{i}")
+            for kw in node.keywords:
+                if kw.arg in names and \
+                        isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+                    yield Finding(
+                        self.code, rel, node.lineno,
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"literal passed as static `{kw.arg}` of jitted "
+                        f"`{jd.qualname}` — static args are hashed as "
+                        "compile-cache keys",
+                        symbol=f"{jd.qualname}:static-{kw.arg}")
+
+    def _branches(self, rel: str, jd: _JitDef) -> Iterator[Finding]:
+        """Flag ``if``/``while`` tests inside a jitted body that depend
+        on a traced parameter.  Only the function's own parameters count
+        — closure variables are bound at trace time and are static."""
+        params = {a.arg for a in jd.fn.args.posonlyargs + jd.fn.args.args
+                  + jd.fn.args.kwonlyargs} - jd.static
+        for node in ast.walk(jd.fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            hazards = _traced_names(node.test) & params
+            for name in sorted(hazards):
+                yield Finding(
+                    self.code, rel, node.lineno,
+                    f"`{jd.qualname}` branches on traced parameter "
+                    f"`{name}` — this retraces per value (or raises "
+                    "TracerBoolConversionError); hoist the branch out of "
+                    "the jit or declare the parameter static",
+                    symbol=f"{jd.qualname}:branch:{name}")
+
+    def _class_assign(self, node: ast.Assign, cls: str, qual: str,
+                      jit_defs: list[_JitDef],
+                      bound: dict[str, tuple[str, int]],
+                      loose: list[tuple[int, str, str]]) -> None:
+        """Inside a class: record `self.X = <jitted local def>` bindings
+        and flag direct `self.X = jax.jit(...)` / subscript jit assigns."""
+        local_jits = {jd.fn.name for jd in jit_defs}
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id in local_jits:
+                    bound[node.value.id] = (f"{cls}.{tgt.attr}",
+                                            node.lineno)
+                elif _jit_call(node.value) is not None:
+                    loose.append((node.lineno, f"self.{tgt.attr}",
+                                  qual or cls))
+            elif _jit_call(node.value) is not None:
+                loose.append((node.lineno, ast.unparse(tgt),
+                              qual or cls))

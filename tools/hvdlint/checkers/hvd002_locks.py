@@ -1,0 +1,318 @@
+"""HVD002 — lock discipline on thread-shared classes.
+
+The metrics registry, monitor threads, and the eager engine all share
+mutable state across threads under ``threading.Lock``/``RLock``.  The
+convention this checker enforces (documented in docs/lint.md):
+
+* A class that assigns a lock in its body declares what that lock
+  guards via a class attribute::
+
+      _GUARDED_BY_LOCK = ("_counts", "_sum")          # guarded by _lock
+      _GUARDED_BY_LOCK = {"_lock": ("_queue",),       # multi-lock form
+                          "_flush_lock": ("_submitted",)}
+
+* Every mutation of a declared attribute (assignment, augmented
+  assignment, ``del``, item store, mutator-method call, or iteration —
+  iteration of a concurrently-mutated container throws
+  ``RuntimeError``) must happen inside ``with self.<lock>:`` holding
+  the declared lock.
+
+* Escape hatches, because real code takes locks in callers:
+  ``__init__``/``__new__`` are construction-time and exempt; methods
+  whose names end in ``_locked`` are called with the lock already held
+  by convention; and ``_LOCK_HOLDER_METHODS = {"_flush_lock": (...)}``
+  names methods documented to run entirely under a lock taken by their
+  caller.
+
+The checker also reports declaration drift: declared attributes never
+assigned in the class (stale), declared locks that do not exist, and —
+in the strict file list from the issue (``metrics.py``, ``monitor.py``,
+``serving_scheduler.py``, ``ops/eager.py``, ``ops/handle_manager.py``)
+— lock-holding classes with no declaration at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "update", "add", "setdefault", "appendleft",
+    "sort", "reverse", "write", "flush", "close",
+}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` / bare ``Lock()``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _LOCK_CTORS
+    return (isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> ``"X"``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except ValueError:
+        return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.locks: set[str] = set()               # self attrs holding locks
+        self.guarded: dict[str, str] = {}          # attr -> lock attr
+        self.declared = False
+        self.decl_line = node.lineno
+        self.holder_methods: dict[str, set[str]] = {}  # lock -> methods
+        self.assigned_attrs: set[str] = set()      # any self.X = ... seen
+        self._scan()
+
+    def _scan(self) -> None:
+        for item in self.node.body:
+            if isinstance(item, ast.Assign) and \
+                    len(item.targets) == 1 and \
+                    isinstance(item.targets[0], ast.Name):
+                name = item.targets[0].id
+                if name == "_GUARDED_BY_LOCK":
+                    self.declared = True
+                    self.decl_line = item.lineno
+                    val = _literal(item.value)
+                    if isinstance(val, dict):
+                        for lock, attrs in val.items():
+                            for a in attrs:
+                                self.guarded[a] = lock
+                    elif isinstance(val, (tuple, list)):
+                        for a in val:
+                            self.guarded[a] = "_lock"
+                elif name == "_LOCK_HOLDER_METHODS":
+                    val = _literal(item.value)
+                    if isinstance(val, dict):
+                        self.holder_methods = {
+                            k: set(v) for k, v in val.items()}
+        for sub in ast.walk(self.node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                value = sub.value
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    self.assigned_attrs.add(attr)
+                    if value is not None and _is_lock_ctor(value):
+                        self.locks.add(attr)
+                    elif value is not None and \
+                            (attr == "_lock" or attr.endswith("_lock")) \
+                            and isinstance(value, (ast.Name,
+                                                   ast.Attribute)):
+                        # `self._lock = lock` — a lock handed in by the
+                        # owner (the metrics registry shares one lock
+                        # across its instruments); the naming convention
+                        # is the signal.
+                        self.locks.add(attr)
+
+
+@register
+class LockDisciplineChecker(Checker):
+    code = "HVD002"
+    summary = ("lock discipline: guarded attribute touched outside "
+               "`with self.<lock>:`, or _GUARDED_BY_LOCK declaration "
+               "missing/stale")
+
+    STRICT_FILES = (
+        "horovod_tpu/metrics.py",
+        "horovod_tpu/monitor.py",
+        "horovod_tpu/serving_scheduler.py",
+        "horovod_tpu/ops/eager.py",
+        "horovod_tpu/ops/handle_manager.py",
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        strict = (project.hvd002_strict_files
+                  if project.hvd002_strict_files is not None
+                  else self.STRICT_FILES)
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(
+                        sf.rel, node, strict_file=sf.rel in strict)
+
+    def _check_class(self, rel: str, node: ast.ClassDef, *,
+                     strict_file: bool) -> Iterator[Finding]:
+        info = _ClassInfo(node)
+        if not info.locks:
+            return
+        if not info.declared:
+            if strict_file:
+                yield Finding(
+                    self.code, rel, node.lineno,
+                    f"class `{node.name}` holds a threading lock but "
+                    "declares no _GUARDED_BY_LOCK — declare what the "
+                    "lock guards (see docs/lint.md)",
+                    symbol=f"{node.name}:undeclared")
+            return
+
+        # Declaration drift.
+        for attr, lock in sorted(info.guarded.items()):
+            if lock not in info.locks:
+                yield Finding(
+                    self.code, rel, info.decl_line,
+                    f"`{node.name}._GUARDED_BY_LOCK` names lock "
+                    f"`{lock}` which is never assigned a "
+                    "threading.Lock/RLock in this class",
+                    symbol=f"{node.name}.{attr}:unknown-lock")
+            if attr not in info.assigned_attrs:
+                yield Finding(
+                    self.code, rel, info.decl_line,
+                    f"`{node.name}._GUARDED_BY_LOCK` declares `{attr}` "
+                    "but the class never assigns it — stale declaration",
+                    symbol=f"{node.name}.{attr}:stale-declaration")
+
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if item.name in ("__init__", "__new__") or \
+                    item.name.endswith("_locked"):
+                continue
+            held0 = {lock for lock, methods in info.holder_methods.items()
+                     if item.name in methods}
+            yield from self._walk_body(rel, node.name, item.name,
+                                       item.body, held0, info)
+
+    # -- body walk with the held-lock set ----------------------------------
+
+    def _walk_body(self, rel: str, cls: str, meth: str,
+                   stmts: list[ast.stmt], held: set[str],
+                   info: _ClassInfo) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                extra = set()
+                for w in stmt.items:
+                    attr = _self_attr(w.context_expr)
+                    if attr in info.locks:
+                        extra.add(attr)
+                yield from self._walk_body(rel, cls, meth, stmt.body,
+                                           held | extra, info)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: runs later, possibly on another thread —
+                # analyze with no held locks
+                yield from self._walk_body(rel, cls, meth, stmt.body,
+                                           set(), info)
+                continue
+            yield from self._check_stmt(rel, cls, meth, stmt, held, info)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    yield from self._walk_body(rel, cls, meth, sub,
+                                               held, info)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._walk_body(rel, cls, meth, handler.body,
+                                           held, info)
+
+    def _check_stmt(self, rel: str, cls: str, meth: str, stmt: ast.stmt,
+                    held: set[str], info: _ClassInfo) -> Iterator[Finding]:
+        def bad(attr: str, line: int, what: str) -> Finding:
+            lock = info.guarded[attr]
+            return Finding(
+                self.code, rel, line,
+                f"`{cls}.{meth}` {what} `self.{attr}` without holding "
+                f"`self.{lock}` (declared guard); wrap in `with "
+                f"self.{lock}:` or rename the method `*_locked`",
+                symbol=f"{cls}.{meth}.{attr}")
+
+        def target_attr(tgt: ast.AST) -> str | None:
+            # self.X = / self.X[...] = / self.X += ...
+            attr = _self_attr(tgt)
+            if attr is not None:
+                return attr
+            if isinstance(tgt, ast.Subscript):
+                return _self_attr(tgt.value)
+            return None
+
+        # Direct assignments / deletes.
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                tgts = tgt.elts if isinstance(
+                    tgt, (ast.Tuple, ast.List)) else [tgt]
+                for t in tgts:
+                    attr = target_attr(t)
+                    if attr in info.guarded and \
+                            info.guarded[attr] not in held:
+                        yield bad(attr, stmt.lineno, "assigns")
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                attr = target_attr(tgt)
+                if attr in info.guarded and \
+                        info.guarded[attr] not in held:
+                    yield bad(attr, stmt.lineno, "deletes from")
+
+        # Iteration over a guarded container.
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            attr = _self_attr(stmt.iter)
+            if attr in info.guarded and info.guarded[attr] not in held:
+                yield bad(attr, stmt.lineno, "iterates over")
+
+        # Mutator calls and comprehension iteration inside this
+        # statement's own expressions (nested statement bodies are
+        # visited by _walk_body, not here, so nothing double-counts).
+        for expr in self._expr_roots(stmt):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    attr = _self_attr(node.func.value)
+                    if attr in info.guarded and \
+                            node.func.attr in _MUTATORS and \
+                            info.guarded[attr] not in held:
+                        yield bad(attr, node.lineno,
+                                  f"calls .{node.func.attr}() on")
+                if isinstance(node, (ast.ListComp, ast.SetComp,
+                                     ast.DictComp, ast.GeneratorExp)):
+                    for gen in node.generators:
+                        attr = _self_attr(gen.iter)
+                        if attr in info.guarded and \
+                                info.guarded[attr] not in held:
+                            yield bad(attr, node.lineno, "iterates over")
+
+    @staticmethod
+    def _expr_roots(stmt: ast.stmt) -> list[ast.expr]:
+        """The expressions evaluated by this statement itself (not the
+        bodies of nested compound statements)."""
+        roots: list[ast.expr] = []
+        for field in ("value", "test", "iter", "exc", "msg"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, ast.expr):
+                roots.append(v)
+        for field in ("targets",):
+            for v in getattr(stmt, field, []) or []:
+                if isinstance(v, ast.expr):
+                    roots.append(v)
+        tgt = getattr(stmt, "target", None)
+        if isinstance(tgt, ast.expr):
+            roots.append(tgt)
+        if isinstance(stmt, ast.With):
+            for w in stmt.items:
+                roots.append(w.context_expr)
+        return roots

@@ -1,0 +1,153 @@
+"""HVD003 — environment-knob registry.
+
+Every ``HVD_TPU_*`` / ``HOROVOD_*`` environment variable the package
+reads must appear in the canonical ``horovod_tpu.knobs.ENV_KNOBS``
+table *and* in the docs knob table (``docs/observability.md``), and
+both tables must be free of dead entries — four directions total:
+
+* a getenv site whose knob is missing from ``ENV_KNOBS`` (anchored at
+  the read site);
+* an ``ENV_KNOBS`` row no code reads (anchored at the table);
+* an ``ENV_KNOBS`` row missing from the docs table;
+* a docs-table row missing from ``ENV_KNOBS``.
+
+Read sites recognized: ``os.environ.get(K)`` / ``os.getenv(K)`` /
+``os.environ[K]`` (Load context only — launch scripts *writing* child
+env don't count) and the repo's typed helpers (``_get_int``,
+``_get_float``, ``_get_bool``, ``_get_tristate``, ``_env_float``).
+The knob-name argument may be a string literal or a module-level
+string constant (``HOROVOD_TIMELINE = "HOROVOD_TIMELINE"`` — the
+``utils/env.py`` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_KNOB_RE = re.compile(r"^(?:HVD_TPU|HOROVOD)_[A-Z0-9_]+$")
+_HELPERS = {"_get_int", "_get_float", "_get_bool", "_get_tristate",
+            "_env_float"}
+_DOCS_ROW_RE = re.compile(r"^\|\s*`([A-Z0-9_]+)`\s*\|")
+
+
+def _module_str_constants(tree: ast.AST) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _knob_arg(node: ast.expr | None,
+              constants: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    return None
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` or bare ``environ``."""
+    if isinstance(node, ast.Name):
+        return node.id == "environ"
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+def iter_read_sites(tree: ast.AST) -> Iterator[tuple[str, int]]:
+    """(knob name, line) for every env read in a module."""
+    constants = _module_str_constants(tree)
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                    _is_environ(f.value):
+                name = _knob_arg(node.args[0] if node.args else None,
+                                 constants)
+            elif isinstance(f, ast.Attribute) and f.attr == "getenv" and \
+                    isinstance(f.value, ast.Name) and f.value.id == "os":
+                name = _knob_arg(node.args[0] if node.args else None,
+                                 constants)
+            elif isinstance(f, ast.Name) and \
+                    (f.id == "getenv" or f.id in _HELPERS):
+                name = _knob_arg(node.args[0] if node.args else None,
+                                 constants)
+        elif isinstance(node, ast.Subscript) and \
+                _is_environ(node.value) and \
+                isinstance(node.ctx, ast.Load):
+            name = _knob_arg(node.slice, constants)
+        if name and _KNOB_RE.match(name):
+            yield name, node.lineno
+
+
+@register
+class EnvKnobChecker(Checker):
+    code = "HVD003"
+    summary = ("env knob not in the canonical ENV_KNOBS table / docs "
+               "knob table, or a table row no code reads")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        table = {row[0] for row in project.env_knobs}
+        read: dict[str, tuple[str, int]] = {}   # knob -> first site
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for name, line in iter_read_sites(sf.tree):
+                read.setdefault(name, (sf.rel, line))
+                if name not in table:
+                    yield Finding(
+                        self.code, sf.rel, line,
+                        f"env knob `{name}` is read here but missing "
+                        "from horovod_tpu.knobs.ENV_KNOBS — add a row "
+                        "(name, default, help)",
+                        symbol=f"{name}:unregistered")
+
+        knobs_rel = project.KNOBS_FILE
+        for name in sorted(table - set(read)):
+            yield Finding(
+                self.code, knobs_rel,
+                project.line_of(knobs_rel, f'"{name}"'),
+                f"ENV_KNOBS row `{name}` is never read by any "
+                "getenv/helper site — dead entry, remove it",
+                symbol=f"{name}:dead-entry")
+
+        # Docs table <-> ENV_KNOBS, both directions.
+        docs_rel = project.docs_knobs_file
+        docs_path = project.root / docs_rel
+        if not docs_path.exists():
+            if table:
+                yield Finding(
+                    self.code, knobs_rel, 1,
+                    f"docs knob table file `{docs_rel}` does not exist "
+                    "but ENV_KNOBS is non-empty",
+                    symbol="docs:missing")
+            return
+        documented: dict[str, int] = {}
+        for i, ln in enumerate(docs_path.read_text().splitlines(), 1):
+            m = _DOCS_ROW_RE.match(ln.strip())
+            if m and _KNOB_RE.match(m.group(1)):
+                documented.setdefault(m.group(1), i)
+        for name in sorted(table - set(documented)):
+            yield Finding(
+                self.code, knobs_rel,
+                project.line_of(knobs_rel, f'"{name}"'),
+                f"ENV_KNOBS row `{name}` is missing from the knob table "
+                f"in {docs_rel} (regenerate with "
+                "`python -m horovod_tpu.knobs`)",
+                symbol=f"{name}:undocumented")
+        for name in sorted(set(documented) - table):
+            yield Finding(
+                self.code, docs_rel, documented[name],
+                f"documented knob `{name}` is not in ENV_KNOBS — stale "
+                "docs row, remove it or register the knob",
+                symbol=f"{name}:stale-docs")

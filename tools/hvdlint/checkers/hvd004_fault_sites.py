@@ -1,0 +1,83 @@
+"""HVD004 — fault-site coverage.
+
+``horovod_tpu.faults`` injects deterministic faults at named sites; the
+canonical site list is ``metrics.FAULT_SITES``.  A registered site that
+nothing injects at is dead configuration surface; an injection site not
+in the table is invisible to ops dashboards; and a site no test ever
+exercises is untested failure handling.  Three rules, each anchored
+where the fix goes:
+
+* every ``FAULT_SITES`` entry has at least one ``.check("<site>")``
+  call in the package (anchored at the table entry);
+* every ``.check("<site>")`` call names a registered site (anchored at
+  the call);
+* every ``FAULT_SITES`` entry appears somewhere in ``tests/`` text —
+  the weakest reference that still proves a test drives the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+
+def iter_check_sites(tree: ast.AST) -> Iterator[tuple[str, int]]:
+    """(site, line) for every ``<x>.check("site")`` / ``check("site")``
+    call whose first argument is a string literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        is_check = (isinstance(f, ast.Attribute) and f.attr == "check") \
+            or (isinstance(f, ast.Name) and f.id == "check")
+        if not is_check:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and "." in arg.value and arg.value.islower():
+            yield arg.value, node.lineno
+
+
+@register
+class FaultSiteChecker(Checker):
+    code = "HVD004"
+    summary = ("FAULT_SITES entry with no injection call site or no "
+               "test reference, or a .check() site not registered")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        registered = set(project.fault_sites)
+        injected: dict[str, tuple[str, int]] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for site, line in iter_check_sites(sf.tree):
+                injected.setdefault(site, (sf.rel, line))
+                if site not in registered:
+                    yield Finding(
+                        self.code, sf.rel, line,
+                        f"fault injection at `{site}` but that site is "
+                        "not registered in metrics.FAULT_SITES — add it "
+                        "so injection configs and dashboards see it",
+                        symbol=f"{site}:unregistered")
+
+        metrics_rel = project.METRICS_FILE
+        tests_text = "\n".join(
+            p.read_text() for p in project.test_files)
+        for site in registered:
+            anchor = project.line_of(metrics_rel, f'"{site}"')
+            if site not in injected:
+                yield Finding(
+                    self.code, metrics_rel, anchor,
+                    f"FAULT_SITES entry `{site}` has no .check() "
+                    "injection call site anywhere in the package — "
+                    "dead site, remove it or wire the injection point",
+                    symbol=f"{site}:no-injection-site")
+            if site not in tests_text:
+                yield Finding(
+                    self.code, metrics_rel, anchor,
+                    f"FAULT_SITES entry `{site}` is referenced by no "
+                    "test under tests/ — the site's failure handling "
+                    "is unexercised",
+                    symbol=f"{site}:no-test-reference")

@@ -1,0 +1,108 @@
+"""hvdlint command line.
+
+Exit codes: 0 clean (no active findings, no stale baseline entries),
+1 findings/stale entries, 2 usage error.  ``--json`` prints the schema
+documented in docs/lint.md; text mode prints ``path:line: CODE message``
+per active finding plus a one-line summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _ensure_importable() -> None:
+    # When invoked as a console script from an arbitrary cwd, the repo
+    # root may not be on sys.path; the package imports below need it.
+    here = pathlib.Path(__file__).resolve()
+    root = here.parents[2]
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+
+
+_ensure_importable()
+
+from tools.hvdlint import core  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvdlint",
+        description="AST-based invariant linter for the horovod_tpu "
+                    "serving stack (see docs/lint.md).")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="repo-relative path prefixes to report on (default: all)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the machine-readable result object")
+    parser.add_argument(
+        "--baseline", default="auto", metavar="FILE",
+        help="baseline file (default: tools/hvdlint/baseline.json when "
+             "present; pass 'none' to disable)")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current active findings to the baseline file "
+             "(justifications start as TODO and must be hand-edited)")
+    parser.add_argument("--list-codes", action="store_true",
+                        help="print the finding codes and exit")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    args = parser.parse_args(argv)
+
+    if args.list_codes:
+        core.all_checkers()  # populate CODES
+        for code, summary in sorted(core.CODES.items()):
+            print(f"{code}  {summary}")
+        return 0
+
+    try:
+        root = core.find_repo_root(
+            pathlib.Path(args.root).resolve() if args.root else None)
+    except RuntimeError as e:
+        print(f"hvdlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline: str | None
+    if args.baseline == "none":
+        baseline = None
+    elif args.baseline == "auto":
+        baseline = "auto"
+    else:
+        baseline = args.baseline
+
+    if args.write_baseline:
+        result = core.run_lint(root, baseline=None)
+        bpath = (root / core.BASELINE_DEFAULT if baseline in ("auto", None)
+                 else pathlib.Path(baseline))
+        core.save_baseline(bpath, result.active)
+        print(f"wrote {len(result.active)} entries to {bpath} "
+              "(edit each TODO justification before committing)")
+        return 0
+
+    result = core.run_lint(root, baseline=baseline,
+                           paths=args.paths or None)
+
+    if args.as_json:
+        json.dump(result.to_dict(), sys.stdout, indent=2)
+        print()
+        return 0 if result.ok else 1
+
+    for f in result.active:
+        print(f.render())
+    for entry in result.stale_baseline:
+        print(f"baseline: stale entry {entry['fingerprint']!r} — no "
+              "current finding matches (or justification missing); "
+              "remove it or fix its justification")
+    n = len(result.active)
+    print(f"hvdlint: {result.files_scanned} files, {n} active finding"
+          f"{'s' if n != 1 else ''}, {len(result.suppressed)} suppressed, "
+          f"{len(result.baselined)} baselined, "
+          f"{len(result.stale_baseline)} stale baseline entries")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

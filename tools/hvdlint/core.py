@@ -1,0 +1,506 @@
+"""hvdlint core: findings, suppressions, baselines, and the checker
+plugin registry.
+
+The serving stack rests on conventions no runtime test can enforce
+globally — one jit signature per program, lock discipline on
+thread-shared registries, canonical name/knob tables that dashboards
+and launch scripts key on.  ``hvdlint`` turns those conventions into
+machine-checked rules: each rule is a :class:`Checker` subclass with a
+stable ``HVDxxx`` code, registered via :func:`register` and run over a
+:class:`Project` (the parsed source tree plus the canonical tables,
+extracted from the package **by AST literal parsing**, never by
+importing it — the linter stays stdlib-only and jax-free).
+
+Three escape hatches keep the tool honest instead of ignored:
+
+* inline suppressions — ``# hvdlint: disable=HVD002 -- <justification>``
+  on the flagged line (or the line above).  The justification after
+  ``--`` is mandatory; a bare ``disable=`` is itself a finding
+  (:data:`MALFORMED_SUPPRESSION`).
+* a committed baseline (``tools/hvdlint/baseline.json``) of
+  grandfathered findings keyed by line-independent fingerprints, each
+  carrying a one-line justification.  Stale entries (fingerprints no
+  finding matches anymore) fail the run, so the baseline only shrinks.
+* per-class declarations (``_GUARDED_BY_LOCK`` etc.) documented in
+  ``docs/lint.md`` — conventions the checkers read, not magic.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import tokenize
+from typing import Any, Iterable, Iterator
+
+#: Code used for problems with the lint metadata itself: files that do
+#: not parse, suppressions missing their mandatory justification.
+MALFORMED_SUPPRESSION = "HVD000"
+
+#: code -> one-line summary; filled by :func:`register` (plus HVD000).
+CODES: dict[str, str] = {
+    MALFORMED_SUPPRESSION:
+        "unparsable file or malformed suppression (missing `-- reason`)",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable=([A-Z0-9,\s]+?)\s*(?:--\s*(\S.*?))?\s*$")
+
+
+# ---------------------------------------------------------------------------
+# Findings.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.  ``symbol`` is the checker-chosen stable key
+    (a qualname, attribute, or table-entry name — never a line number),
+    so ``fingerprint`` survives unrelated edits that shift lines."""
+
+    code: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+    symbol: str
+    status: str = "active"      # active | suppressed | baselined
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "message": self.message, "fingerprint": self.fingerprint,
+                "status": self.status}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    path: str
+    line: int                     # line the comment sits on
+    codes: tuple[str, ...]
+    justification: str | None
+    used: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Source model.
+# ---------------------------------------------------------------------------
+
+
+class SourceFile:
+    """One parsed source file: text, AST (lazily; ``None`` when the file
+    does not parse — the runner reports that as HVD000), and the
+    per-line comment map from :mod:`tokenize`."""
+
+    def __init__(self, root: pathlib.Path, path: pathlib.Path):
+        self.abs = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self._tree: ast.AST | None = None
+        self._parse_error: SyntaxError | None = None
+        self._parsed = False
+        self._comments: dict[int, str] | None = None
+
+    @property
+    def tree(self) -> ast.AST | None:
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> SyntaxError | None:
+        self.tree  # noqa: B018 — force the parse attempt
+        return self._parse_error
+
+    @property
+    def comments(self) -> dict[int, str]:
+        if self._comments is None:
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.text).readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+        return self._comments
+
+    def suppressions(self) -> list[Suppression]:
+        out = []
+        for line, text in sorted(self.comments.items()):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            codes = tuple(c.strip() for c in m.group(1).split(",")
+                          if c.strip())
+            out.append(Suppression(self.rel, line, codes, m.group(2)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The project: source tree + canonical tables.
+# ---------------------------------------------------------------------------
+
+
+def _extract_literal(path: pathlib.Path, name: str) -> Any:
+    """Read a module-level literal assignment (``NAME = <literal>`` or
+    ``NAME: T = <literal>``) out of ``path`` WITHOUT importing it.
+    Returns None when the file or assignment is missing or the value is
+    not a pure literal."""
+    if not path.exists():
+        return None
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            target = node.target.id
+        if target != name:
+            continue
+        try:
+            return ast.literal_eval(node.value)
+        except ValueError:
+            return None
+    return None
+
+
+def find_repo_root(start: pathlib.Path | None = None) -> pathlib.Path:
+    """Walk up from ``start`` (default: this file) to the directory that
+    holds the ``horovod_tpu`` package — the lint root."""
+    here = (start or pathlib.Path(__file__)).resolve()
+    for cand in [here, *here.parents]:
+        if (cand / "horovod_tpu" / "__init__.py").exists():
+            return cand
+    raise RuntimeError("cannot locate the repo root (no horovod_tpu/ "
+                       f"package above {here})")
+
+
+class Project:
+    """Everything a checker may look at: the parsed package sources, the
+    test files, and the canonical tables.  Table keyword arguments
+    override the AST-extracted defaults so fixture tests can build tiny
+    synthetic projects (see tests/test_lint.py)."""
+
+    METRICS_FILE = "horovod_tpu/metrics.py"
+    KNOBS_FILE = "horovod_tpu/knobs.py"
+
+    def __init__(self, root: str | pathlib.Path, *,
+                 package_dirs: tuple[str, ...] = ("horovod_tpu",),
+                 test_dir: str = "tests",
+                 docs_knobs_file: str = "docs/observability.md",
+                 env_knobs: tuple | None = None,
+                 fault_sites: tuple | None = None,
+                 metric_help: dict | None = None,
+                 timeline_counter_series: dict | None = None,
+                 lifecycle_event_counters: dict | None = None,
+                 hvd001_targets: tuple[str, ...] | None = None,
+                 hvd002_strict_files: tuple[str, ...] | None = None):
+        self.root = pathlib.Path(root).resolve()
+        self.package_dirs = package_dirs
+        self.docs_knobs_file = docs_knobs_file
+        self.files: list[SourceFile] = []
+        for pkg in package_dirs:
+            base = self.root / pkg
+            if base.is_file():
+                self.files.append(SourceFile(self.root, base))
+                continue
+            for p in sorted(base.rglob("*.py")):
+                if "__pycache__" in p.parts:
+                    continue
+                self.files.append(SourceFile(self.root, p))
+        tdir = self.root / test_dir
+        self.test_files: list[pathlib.Path] = (
+            sorted(tdir.glob("*.py")) if tdir.is_dir() else [])
+
+        self._env_knobs = env_knobs
+        self._fault_sites = fault_sites
+        self._metric_help = metric_help
+        self._timeline_counter_series = timeline_counter_series
+        self._lifecycle_event_counters = lifecycle_event_counters
+        self.hvd001_targets = hvd001_targets
+        self.hvd002_strict_files = hvd002_strict_files
+
+    # -- canonical tables (AST-extracted, never imported) ------------------
+
+    def _table(self, cached: Any, relpath: str, name: str,
+               default: Any) -> Any:
+        if cached is not None:
+            return cached
+        val = _extract_literal(self.root / relpath, name)
+        return default if val is None else val
+
+    @property
+    def env_knobs(self) -> tuple:
+        """``horovod_tpu.knobs.ENV_KNOBS``: (name, default, help) rows."""
+        return self._table(self._env_knobs, self.KNOBS_FILE,
+                           "ENV_KNOBS", ())
+
+    @property
+    def fault_sites(self) -> tuple:
+        return self._table(self._fault_sites, self.METRICS_FILE,
+                           "FAULT_SITES", ())
+
+    @property
+    def metric_help(self) -> dict:
+        return self._table(self._metric_help, self.METRICS_FILE,
+                           "METRIC_HELP", {})
+
+    @property
+    def timeline_counter_series(self) -> dict:
+        return self._table(self._timeline_counter_series, self.METRICS_FILE,
+                           "TIMELINE_COUNTER_SERIES", {})
+
+    @property
+    def lifecycle_event_counters(self) -> dict:
+        return self._table(self._lifecycle_event_counters, self.METRICS_FILE,
+                           "LIFECYCLE_EVENT_COUNTERS", {})
+
+    # -- anchors -----------------------------------------------------------
+
+    def line_of(self, relpath: str, needle: str) -> int:
+        """First line (1-based) containing ``needle`` in ``relpath`` —
+        used to anchor table-level findings at the table entry; 1 when
+        the needle or file is absent."""
+        path = self.root / relpath
+        if not path.exists():
+            return 1
+        for i, ln in enumerate(path.read_text().splitlines(), 1):
+            if needle in ln:
+                return i
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# Checker registry.
+# ---------------------------------------------------------------------------
+
+
+class Checker:
+    """Base class for one lint rule family.  Subclasses set ``code``
+    (stable ``HVDxxx`` identifier) and ``summary``, register with
+    :func:`register`, and yield :class:`Finding`\\ s from ``check``."""
+
+    code = "HVD999"
+    summary = "abstract checker"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+_REGISTRY: list[type[Checker]] = []
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    """Class decorator: add a checker to the suite and its code to
+    :data:`CODES`.  Re-registration (module reload) replaces by code."""
+    global _REGISTRY
+    _REGISTRY = [c for c in _REGISTRY if c.code != cls.code]
+    _REGISTRY.append(cls)
+    CODES[cls.code] = cls.summary
+    return cls
+
+
+def all_checkers() -> list[type[Checker]]:
+    """The registered checkers, importing the built-in plugin package on
+    first use (each ``tools/hvdlint/checkers/hvdNNN_*.py`` registers
+    itself at import)."""
+    from tools.hvdlint import checkers  # noqa: F401 — side-effect import
+    return sorted(_REGISTRY, key=lambda c: c.code)
+
+
+# ---------------------------------------------------------------------------
+# Baseline.
+# ---------------------------------------------------------------------------
+
+BASELINE_DEFAULT = "tools/hvdlint/baseline.json"
+
+
+def load_baseline(path: pathlib.Path) -> dict[str, dict]:
+    """fingerprint -> entry.  Every entry must carry a non-empty
+    ``justification`` — an unjustified entry is reported as stale so it
+    cannot silently grandfather a finding."""
+    data = json.loads(path.read_text())
+    out = {}
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] = entry
+    return out
+
+
+def save_baseline(path: pathlib.Path, findings: Iterable[Finding]) -> None:
+    entries = [{"fingerprint": f.fingerprint, "code": f.code,
+                "path": f.path,
+                "justification": "TODO: one-line justification"}
+               for f in sorted(findings,
+                               key=lambda f: (f.path, f.line, f.code))]
+    path.write_text(json.dumps(
+        {"version": 1, "tool": "hvdlint", "findings": entries},
+        indent=2) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# The runner.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    root: str
+    findings: list[Finding]               # every finding, any status
+    stale_baseline: list[dict]
+    unused_suppressions: list[Suppression]
+    files_scanned: int
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "active"]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "suppressed"]
+
+    @property
+    def baselined(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "baselined"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.active and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        """The ``--json`` schema (documented in docs/lint.md)."""
+        return {
+            "version": 1,
+            "root": self.root,
+            "codes": dict(sorted(CODES.items())),
+            "summary": {
+                "files_scanned": self.files_scanned,
+                "total": len(self.findings),
+                "active": len(self.active),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "stale_baseline": len(self.stale_baseline),
+                "ok": self.ok,
+            },
+            "findings": [f.to_dict() for f in self.findings],
+            "stale_baseline": self.stale_baseline,
+            "unused_suppressions": [
+                {"path": s.path, "line": s.line, "codes": list(s.codes)}
+                for s in self.unused_suppressions],
+        }
+
+
+def _dedupe_fingerprints(findings: list[Finding]) -> None:
+    """Same-symbol findings (two unguarded mutations of one attribute in
+    one method) get ``#2``, ``#3``… suffixes in line order, so every
+    fingerprint is unique and baselines stay exact."""
+    seen: dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        n = seen.get(f.fingerprint, 0)
+        seen[f.fingerprint] = n + 1
+        if n:
+            f.symbol = f"{f.symbol}#{n + 1}"
+
+
+def run_lint(root: str | pathlib.Path | None = None, *,
+             project: Project | None = None,
+             baseline: str | pathlib.Path | None = "auto",
+             checkers: Iterable[type[Checker]] | None = None,
+             paths: Iterable[str] | None = None) -> LintResult:
+    """Run the suite and resolve suppressions + baseline.
+
+    ``baseline="auto"`` uses the committed ``tools/hvdlint/baseline.json``
+    when present; ``None`` disables baselining.  ``paths`` (repo-relative
+    prefixes) restricts which files' findings are reported — table-level
+    findings anchor to the table file and follow its filtering.
+    """
+    if project is None:
+        project = Project(find_repo_root() if root is None else root)
+    suite = list(checkers) if checkers is not None else all_checkers()
+
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                MALFORMED_SUPPRESSION, sf.rel,
+                sf.parse_error.lineno or 1,
+                f"file does not parse: {sf.parse_error.msg}",
+                symbol="parse-error"))
+    for cls in suite:
+        findings.extend(cls().check(project))
+
+    # Suppressions: collected from every scanned file; a missing
+    # justification is itself a finding and suppresses nothing.
+    suppressions: list[Suppression] = []
+    for sf in project.files:
+        for sup in sf.suppressions():
+            if not sup.justification:
+                findings.append(Finding(
+                    MALFORMED_SUPPRESSION, sup.path, sup.line,
+                    "suppression is missing its mandatory justification "
+                    "(write `# hvdlint: disable=CODE -- <why>`)",
+                    symbol=f"suppression:{','.join(sup.codes)}"))
+            else:
+                suppressions.append(sup)
+
+    by_file: dict[str, list[Suppression]] = {}
+    for sup in suppressions:
+        by_file.setdefault(sup.path, []).append(sup)
+    for f in findings:
+        if f.code == MALFORMED_SUPPRESSION:
+            continue        # the metadata rule cannot suppress itself
+        for sup in by_file.get(f.path, ()):
+            if sup.line in (f.line, f.line - 1) and f.code in sup.codes:
+                f.status = "suppressed"
+                sup.used = True
+                break
+
+    _dedupe_fingerprints(findings)
+
+    # Baseline.
+    stale: list[dict] = []
+    if baseline is not None:
+        bpath = (project.root / BASELINE_DEFAULT
+                 if baseline == "auto" else pathlib.Path(baseline))
+        if bpath.exists():
+            entries = load_baseline(bpath)
+            matched: set[str] = set()
+            for f in findings:
+                if f.status != "active":
+                    continue
+                entry = entries.get(f.fingerprint)
+                if entry and str(entry.get("justification", "")).strip() \
+                        and not str(entry["justification"]).startswith(
+                            "TODO"):
+                    f.status = "baselined"
+                    matched.add(f.fingerprint)
+            stale = [e for fp, e in sorted(entries.items())
+                     if fp not in matched]
+
+    if paths:
+        prefixes = tuple(str(p) for p in paths)
+        findings = [f for f in findings if f.path.startswith(prefixes)]
+
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return LintResult(
+        root=str(project.root), findings=findings, stale_baseline=stale,
+        unused_suppressions=[s for s in suppressions if not s.used],
+        files_scanned=len(project.files))
