@@ -514,6 +514,14 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
         # pass with a live scraper thread vs the metrics-on pass).
         "serve_goodput": round(r["serve_goodput"], 4),
         "monitor_overhead_pct": round(r["monitor_overhead_pct"], 2),
+        # Per-tick phase profiler: its own cost (profiler-on vs the
+        # metrics-on pass, bound < 3 %) and where tick time goes — the
+        # BENCH_r06+ breakdown for spotting which phase a regression
+        # lives in.
+        "serve_profiler_overhead_pct": round(
+            r["serve_profiler_overhead_pct"], 2),
+        "serve_phase_pct": {k: round(v, 1)
+                            for k, v in r["serve_phase_pct"].items()},
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
                         f"req{len(reqs)}"),
     }

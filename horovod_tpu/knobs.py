@@ -76,6 +76,12 @@ ENV_KNOBS = (
      "Port for the per-rank /metrics + /healthz HTTP exporter."),
     ("HVD_TPU_NEGOTIATE_TIMEOUT_S", "60",
      "Host-card negotiation deadline in seconds during init()."),
+    ("HVD_TPU_PROFILE", "0",
+     "Per-tick phase profiling in ServeEngine (serve.phase.* metrics)."),
+    ("HVD_TPU_PROFILE_WINDOW", "256",
+     "Ticks in the profiler's rolling per-phase report window."),
+    ("HVD_TPU_RETRACE_FATAL", "0",
+     "Raise when the retrace sentry sees a jit cache grow mid-serve."),
     ("HVD_TPU_SLO_E2E_S", "0",
      "End-to-end latency SLO in seconds for goodput (0 = no SLO)."),
     ("HVD_TPU_STRAGGLER_WARN_S", "1.0",

@@ -54,6 +54,7 @@ import dataclasses
 import json
 import math
 import os
+import sys
 import threading
 import time
 from bisect import bisect_left
@@ -137,6 +138,34 @@ METRIC_HELP: dict[str, str] = {
     "serve.retries": "Fault-triggered replays of a request",
     "serve.failures": "Requests terminated FAILED after exhausting retries",
     "serve.prefix_indexed_blocks": "KV pages indexed by the radix prefix cache",
+    "serve.retrace": "Jit cache growths detected mid-serve by the retrace sentry",
+    # serve.phase.* — TickProfiler per-tick phase histograms (seconds);
+    # the top-level phases tile step() wall time, the admit_* sub-phases
+    # nest inside admit, and tick_s is the whole step.
+    "serve.phase.expire_s": "Tick phase: deadline expiry + queue bookkeeping",
+    "serve.phase.admit_s": "Tick phase: admission, preemption, and prefill windows",
+    "serve.phase.admit_cache_acquire_s": "Admit sub-phase: prefix-cache longest-prefix acquire",
+    "serve.phase.admit_prefill_dispatch_s": "Admit sub-phase: chunked-prefill window dispatch",
+    "serve.phase.decode_dispatch_s": "Tick phase: host time dispatching the decode tick",
+    "serve.phase.device_sync_s": "Tick phase: blocking token readback (device wait)",
+    "serve.phase.sample_postprocess_s": "Tick phase: per-slot token handling and retirement",
+    "serve.phase.bookkeeping_s": "Tick phase: counters, gauges, sentry, watchdog",
+    "serve.phase.tick_s": "Whole engine step wall time as the profiler measures it",
+    # kv.* — paged KV pool accounting in blocks AND bytes (bytes derive
+    # from the llama cache dtype/shape: k+v for one block).
+    "kv.free_blocks": "KV pool blocks on the free list",
+    "kv.free_bytes": "KV pool bytes on the free list",
+    "kv.referenced_blocks": "KV pool blocks mapped by live rows",
+    "kv.referenced_bytes": "KV pool bytes mapped by live rows",
+    "kv.cached_blocks": "Zero-ref KV pool blocks parked in the prefix cache",
+    "kv.cached_bytes": "Zero-ref KV pool bytes parked in the prefix cache",
+    "kv.block_bytes": "Device bytes one KV block holds (k+v, all layers)",
+    "kv.total_bytes": "Device bytes of the whole paged KV pool (incl. trash)",
+    # mem.* — host-side observability footprint (approximate)
+    "mem.registry_bytes": "Approximate host bytes held by the metrics registry",
+    "mem.trace_ring_bytes": "Approximate host bytes of live traces + the SLO ring",
+    "mem.event_log_bytes": "Bytes written to the JSONL event log so far",
+    "mem.prefix_index_bytes": "Approximate host bytes of the radix prefix index",
     # prefix.* — RadixPrefixCache counters mirrored from prefix_counters
     "prefix.hits": "Admissions that reused prefix-cache blocks",
     "prefix.blocks_reused": "KV pages spliced from the prefix cache",
@@ -153,15 +182,30 @@ METRIC_HELP: dict[str, str] = {
 # ---------------------------------------------------------------------------
 
 
+class _Gen:
+    """A shared mutation-generation cell: every instrument write bumps
+    ``n`` (under the instrument's lock), so a renderer can cache its
+    output keyed on the generation it rendered and serve the cached text
+    until ANY instrument changes.  Registry-created instruments share
+    the registry's cell; standalone instruments get a private one."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+
 class Counter:
     """A monotonically increasing integer (Prometheus ``counter``)."""
 
-    __slots__ = ("name", "_lock", "_value")
+    __slots__ = ("name", "_lock", "_gen", "_value")
     _GUARDED_BY_LOCK = ("_value",)
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock: threading.Lock,
+                 gen: _Gen | None = None):
         self.name = name
         self._lock = lock
+        self._gen = gen if gen is not None else _Gen()
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -169,6 +213,7 @@ class Counter:
             raise ValueError(f"counter {self.name} cannot decrease (n={n})")
         with self._lock:
             self._value += n
+            self._gen.n += 1
 
     @property
     def value(self) -> int:
@@ -179,17 +224,20 @@ class Counter:
 class Gauge:
     """A last-value-wins float (Prometheus ``gauge``)."""
 
-    __slots__ = ("name", "_lock", "_value")
+    __slots__ = ("name", "_lock", "_gen", "_value")
     _GUARDED_BY_LOCK = ("_value",)
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock: threading.Lock,
+                 gen: _Gen | None = None):
         self.name = name
         self._lock = lock
+        self._gen = gen if gen is not None else _Gen()
         self._value = 0.0
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = float(v)
+            self._gen.n += 1
 
     @property
     def value(self) -> float:
@@ -245,17 +293,19 @@ class Histogram:
     report true values instead of bucket edges.
     """
 
-    __slots__ = ("name", "bounds", "_lock", "_counts", "_count", "_sum",
-                 "_min", "_max")
+    __slots__ = ("name", "bounds", "_lock", "_gen", "_counts", "_count",
+                 "_sum", "_min", "_max")
     _GUARDED_BY_LOCK = ("_counts", "_count", "_sum", "_min", "_max")
 
     def __init__(self, name: str, lock: threading.Lock,
-                 bounds: tuple[float, ...] | None = None):
+                 bounds: tuple[float, ...] | None = None,
+                 gen: _Gen | None = None):
         self.name = name
         self.bounds = tuple(bounds) if bounds else log_bucket_bounds()
         if list(self.bounds) != sorted(self.bounds):
             raise ValueError(f"histogram {name} bounds must ascend")
         self._lock = lock
+        self._gen = gen if gen is not None else _Gen()
         self._counts = [0] * (len(self.bounds) + 1)
         self._count = 0
         self._sum = 0.0
@@ -272,6 +322,7 @@ class Histogram:
                 self._min = v
             if v > self._max:
                 self._max = v
+            self._gen.n += 1
 
     @property
     def count(self) -> int:
@@ -301,22 +352,28 @@ class Histogram:
         form :func:`horovod_tpu.monitor.merge_snapshots` sums exactly
         (one extra slot past ``bounds`` is the overflow bucket)."""
         with self._lock:
-            if self._count == 0:
-                return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
-                        "p50": 0.0, "p90": 0.0, "p99": 0.0,
-                        "buckets": list(self._counts),
-                        "bounds": list(self.bounds)}
-            return {
-                "count": self._count,
-                "sum": self._sum,
-                "min": self._min,
-                "max": self._max,
-                "p50": self._percentile_locked(0.50),
-                "p90": self._percentile_locked(0.90),
-                "p99": self._percentile_locked(0.99),
-                "buckets": list(self._counts),
-                "bounds": list(self.bounds),
-            }
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        # The registry calls this directly inside ITS lock pass — the
+        # instrument lock IS the registry lock there, and a plain Lock
+        # re-taken would wedge.
+        if self._count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "p50": 0.0, "p90": 0.0, "p99": 0.0,
+                    "buckets": list(self._counts),
+                    "bounds": list(self.bounds)}
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "min": self._min,
+            "max": self._max,
+            "p50": self._percentile_locked(0.50),
+            "p90": self._percentile_locked(0.90),
+            "p99": self._percentile_locked(0.99),
+            "buckets": list(self._counts),
+            "bounds": list(self.bounds),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -461,13 +518,22 @@ class MetricsRegistry:
     :class:`EventLog` pins one.
     """
 
-    _GUARDED_BY_LOCK = ("_counters", "_gauges", "_histograms")
+    _GUARDED_BY_LOCK = ("_counters", "_gauges", "_histograms",
+                        "_prom_cache", "_prom_gen")
 
     def __init__(self, event_log: "EventLog | None | str" = "auto"):
+        # ONE lock and ONE generation cell shared by every instrument
+        # this registry creates: snapshot()/to_prometheus() take a
+        # single lock pass over a frozen registry instead of one
+        # acquisition per metric, and any instrument write bumps the
+        # shared generation, invalidating the cached Prometheus text.
         self._lock = threading.Lock()
+        self._gen = _Gen()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        self._prom_cache: str | None = None
+        self._prom_gen = -1
         self._event_log = event_log
 
     def _get(self, table: dict, name: str, factory) -> Any:
@@ -487,25 +553,32 @@ class MetricsRegistry:
 
     def counter(self, name: str) -> Counter:
         return self._get(self._counters, name,
-                         lambda: Counter(name, threading.Lock()))
+                         lambda: Counter(name, self._lock, self._gen))
 
     def gauge(self, name: str) -> Gauge:
         return self._get(self._gauges, name,
-                         lambda: Gauge(name, threading.Lock()))
+                         lambda: Gauge(name, self._lock, self._gen))
 
     def histogram(self, name: str,
                   bounds: tuple[float, ...] | None = None) -> Histogram:
-        return self._get(self._histograms, name,
-                         lambda: Histogram(name, threading.Lock(), bounds))
+        return self._get(
+            self._histograms, name,
+            lambda: Histogram(name, self._lock, bounds, self._gen))
 
     # -- events ------------------------------------------------------------
+
+    def active_event_log(self) -> "EventLog | None":
+        """The sink ``event()`` would write to right now (resolving the
+        ``"auto"`` env indirection), or None."""
+        log = self._event_log
+        if log == "auto":
+            log = env_event_log()
+        return log
 
     def event(self, kind: str, **fields: Any) -> None:
         """Emit one structured event to the configured sink (no-op when
         no sink is configured)."""
-        log = self._event_log
-        if log == "auto":
-            log = env_event_log()
+        log = self.active_event_log()
         if log is not None:
             log.emit(kind, **fields)
 
@@ -514,48 +587,54 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """Plain nested dict of every instrument — JSON-serializable,
         schema-stable (``counters`` / ``gauges`` / ``histograms`` with
-        count/sum/min/max/p50/p90/p99 each)."""
+        count/sum/min/max/p50/p90/p99 each).  One lock pass: instruments
+        share the registry lock, so holding it freezes the whole
+        registry and the fields are read directly."""
         with self._lock:
-            counters = dict(self._counters)
-            gauges = dict(self._gauges)
-            histograms = dict(self._histograms)
-        return {
-            "counters": {n: c.value for n, c in sorted(counters.items())},
-            "gauges": {n: g.value for n, g in sorted(gauges.items())},
-            "histograms": {n: h.snapshot()
-                           for n, h in sorted(histograms.items())},
-        }
+            return {
+                "counters": {n: c._value
+                             for n, c in sorted(self._counters.items())},
+                "gauges": {n: g._value
+                           for n, g in sorted(self._gauges.items())},
+                "histograms": {n: h._snapshot_locked()
+                               for n, h in sorted(self._histograms.items())},
+            }
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition format, version 0.0.4: ``# HELP``
         (from :data:`METRIC_HELP`) and ``# TYPE`` lines plus samples;
         histograms render cumulative ``_bucket`` series with ``le``
         labels, ``_sum`` and ``_count``.  Label values are escaped per
-        the spec via :func:`escape_label_value`."""
+        the spec via :func:`escape_label_value`.
+
+        The rendered text is cached keyed on the registry's mutation
+        generation: consecutive scrapes of an unchanged registry return
+        the same string with zero render work (the monitor-overhead
+        fix).  The shared lock makes the pairing exact — no instrument
+        can move while the render reads it."""
         with self._lock:
-            counters = dict(self._counters)
-            gauges = dict(self._gauges)
-            histograms = dict(self._histograms)
-        lines: list[str] = []
+            if (self._prom_cache is not None
+                    and self._prom_gen == self._gen.n):
+                return self._prom_cache
+            lines: list[str] = []
 
-        def _head(name: str, pn: str, kind: str) -> None:
-            help_text = METRIC_HELP.get(name)
-            if help_text:
-                lines.append(f"# HELP {pn} {_escape_help(help_text)}")
-            lines.append(f"# TYPE {pn} {kind}")
+            def _head(name: str, pn: str, kind: str) -> None:
+                help_text = METRIC_HELP.get(name)
+                if help_text:
+                    lines.append(f"# HELP {pn} {_escape_help(help_text)}")
+                lines.append(f"# TYPE {pn} {kind}")
 
-        for name, c in sorted(counters.items()):
-            pn = _prom_name(name)
-            _head(name, pn, "counter")
-            lines.append(f"{pn} {c.value}")
-        for name, g in sorted(gauges.items()):
-            pn = _prom_name(name)
-            _head(name, pn, "gauge")
-            lines.append(f"{pn} {g.value:g}")
-        for name, h in sorted(histograms.items()):
-            pn = _prom_name(name)
-            _head(name, pn, "histogram")
-            with h._lock:
+            for name, c in sorted(self._counters.items()):
+                pn = _prom_name(name)
+                _head(name, pn, "counter")
+                lines.append(f"{pn} {c._value}")
+            for name, g in sorted(self._gauges.items()):
+                pn = _prom_name(name)
+                _head(name, pn, "gauge")
+                lines.append(f"{pn} {g._value:g}")
+            for name, h in sorted(self._histograms.items()):
+                pn = _prom_name(name)
+                _head(name, pn, "histogram")
                 cum = 0
                 for edge, c in zip(h.bounds, h._counts):
                     cum += c
@@ -564,7 +643,32 @@ class MetricsRegistry:
                 lines.append(f'{pn}_bucket{{le="+Inf"}} {h._count}')
                 lines.append(f"{pn}_sum {h._sum:g}")
                 lines.append(f"{pn}_count {h._count}")
-        return "\n".join(lines) + "\n"
+            text = "\n".join(lines) + "\n"
+            self._prom_cache = text
+            self._prom_gen = self._gen.n
+            return text
+
+    def approx_footprint_bytes(self) -> int:
+        """Approximate host memory the registry itself holds (the
+        ``mem.registry_bytes`` gauge): instruments, their name strings,
+        and histogram bucket arrays — shallow ``sys.getsizeof`` sums, an
+        accounting estimate rather than a deep audit."""
+        with self._lock:
+            total = (sys.getsizeof(self._counters)
+                     + sys.getsizeof(self._gauges)
+                     + sys.getsizeof(self._histograms))
+            for c in self._counters.values():
+                total += sys.getsizeof(c) + sys.getsizeof(c.name)
+            for g in self._gauges.values():
+                total += sys.getsizeof(g) + sys.getsizeof(g.name)
+            for h in self._histograms.values():
+                total += (sys.getsizeof(h) + sys.getsizeof(h.name)
+                          + sys.getsizeof(h.bounds)
+                          + sys.getsizeof(h._counts)
+                          + 28 * len(h._counts))   # the int cells
+            if self._prom_cache is not None:
+                total += sys.getsizeof(self._prom_cache)
+            return total
 
 
 class _NullCounter(Counter):
@@ -598,17 +702,17 @@ class NullRegistry(MetricsRegistry):
 
     def counter(self, name: str) -> Counter:
         return self._get(self._counters, name,
-                         lambda: _NullCounter(name, threading.Lock()))
+                         lambda: _NullCounter(name, self._lock, self._gen))
 
     def gauge(self, name: str) -> Gauge:
         return self._get(self._gauges, name,
-                         lambda: _NullGauge(name, threading.Lock()))
+                         lambda: _NullGauge(name, self._lock, self._gen))
 
     def histogram(self, name: str,
                   bounds: tuple[float, ...] | None = None) -> Histogram:
         return self._get(
             self._histograms, name,
-            lambda: _NullHistogram(name, threading.Lock(), bounds))
+            lambda: _NullHistogram(name, self._lock, bounds, self._gen))
 
     def event(self, kind: str, **fields: Any) -> None:
         pass

@@ -99,7 +99,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         mon = self.server.monitor
-        mon.registry.counter("monitor.scrapes").inc()
+        mon._scrapes.inc()
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
@@ -121,9 +121,20 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply(200, eng.state_dump(),
                                 "text/plain; charset=utf-8")
+            elif path == "/profile":
+                prof = getattr(mon.engine, "prof", None)
+                if prof is None:
+                    self._reply(
+                        404, "profiling off; construct the engine with "
+                             "profile=True or set HVD_TPU_PROFILE=1\n",
+                        "text/plain")
+                else:
+                    self._reply(200, json.dumps(prof.report()),
+                                "application/json")
             else:
                 self._reply(404, "unknown path; try /metrics /snapshot "
-                                 "/healthz /state\n", "text/plain")
+                                 "/healthz /state /profile\n",
+                            "text/plain")
         except BrokenPipeError:  # scraper hung up mid-reply
             pass
 
@@ -136,7 +147,9 @@ class MonitorServer:
     bound to ``host:port`` (``port=0`` picks an ephemeral port — read
     ``.port`` after ``start()``).  Stdlib only, so it costs nothing to
     deploy; scrapes never touch the engine's scheduling loop beyond the
-    registry's per-instrument locks."""
+    registry's shared lock — one short pass per scrape, with the
+    rendered Prometheus text cached against the registry's generation
+    counter so an idle registry serves scrapes without re-rendering."""
 
     class _Server(ThreadingHTTPServer):
         daemon_threads = True
@@ -147,6 +160,13 @@ class MonitorServer:
                  host: str = "127.0.0.1"):
         self.registry = registry if registry is not None else metrics_mod.DEFAULT
         self.engine = engine
+        # The scrape odometer writes on every scrape; left on the
+        # registry's shared generation it would invalidate the rendered
+        # /metrics cache each hit, defeating the cache exactly when it
+        # matters.  A private generation cell keeps the counter live in
+        # snapshots while letting its rendered value lag one scrape.
+        self._scrapes = self.registry.counter("monitor.scrapes")
+        self._scrapes._gen = metrics_mod._Gen()
         self._httpd = MonitorServer._Server((host, port), _Handler)
         self._httpd.monitor = self
         self.host, self.port = self._httpd.server_address[:2]
@@ -485,6 +505,12 @@ class SLOWindow:
         the window default."""
         with self._lock:
             self._traces.append((trace, slo_s))
+
+    def __len__(self) -> int:
+        """Terminal traces currently in the window (the engine's memory
+        accounting sizes the ring with this)."""
+        with self._lock:
+            return len(self._traces)
 
     def _good(self, trace: Any, slo_s: float | None) -> bool:
         if trace.status != "OK":

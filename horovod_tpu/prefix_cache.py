@@ -52,6 +52,7 @@ is pinned by ``tests/test_prefix_cache.py`` against cache-off runs.
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Iterable
 
 from horovod_tpu import metrics as metrics_mod
@@ -113,6 +114,18 @@ class RadixPrefixCache:
 
     def indexed_blocks(self) -> int:
         return len(self._nodes)
+
+    def approx_footprint_bytes(self) -> int:
+        """Approximate host bytes the radix index holds (the
+        ``mem.prefix_index_bytes`` gauge): per node its object, its
+        token-chunk key tuple, and its children dict, plus the block->
+        node map — shallow ``sys.getsizeof`` sums, a leak-spotting
+        trend line rather than an exact audit."""
+        total = sys.getsizeof(self._nodes)
+        for node in [self._root, *self._nodes.values()]:
+            total += (sys.getsizeof(node) + sys.getsizeof(node.key)
+                      + sys.getsizeof(node.children))
+        return total
 
     def __contains__(self, block: int) -> bool:
         return block in self._nodes

@@ -94,6 +94,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import threading
 import time
 from functools import partial
@@ -106,6 +107,7 @@ import numpy as np
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
 from horovod_tpu import monitor as monitor_mod
+from horovod_tpu import profiler as profiler_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import RadixPrefixCache
@@ -248,7 +250,9 @@ class ServeEngine:
                  monitor: "monitor_mod.MonitorServer | int | bool | None"
                      = None,
                  slo_window: int = 256,
-                 slo_e2e_s: float | None = None):
+                 slo_e2e_s: float | None = None,
+                 profile: bool | None = None,
+                 profile_window: int | None = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
                              f"{max_len}]")
@@ -274,6 +278,20 @@ class ServeEngine:
         for h in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
                   "serve.e2e_s"):
             self.metrics.histogram(h)
+        # Per-tick phase profiler: None = env-driven (HVD_TPU_PROFILE=1).
+        # Off means prof is None and every call site is one `is not
+        # None` test — the hot path pays nothing.
+        if profile is None:
+            profile = os.environ.get("HVD_TPU_PROFILE", "") == "1"
+        self.prof = (profiler_mod.TickProfiler(
+            self.metrics, timeline=timeline, window=profile_window)
+            if profile else None)
+        # Retrace sentry: the dynamic complement to hvdlint HVD001 —
+        # compile_cache_sizes() is diffed every step and any mid-serve
+        # growth bumps serve.retrace (fatal under HVD_TPU_RETRACE_FATAL=1).
+        self._retrace_fatal = os.environ.get(
+            "HVD_TPU_RETRACE_FATAL", "") == "1"
+        self.metrics.counter("serve.retrace")
         self._t0 = time.monotonic()
         self._last_step_ts: float | None = None
         # SLO goodput window: every terminal trace lands here; the
@@ -310,6 +328,16 @@ class ServeEngine:
         # legacy alias: the SAME list object the pool allocates from
         # (white-box tests drain it to force block starvation)
         self._free_blocks = self.pool._free
+        # KV memory accounting: one physical block holds block_size
+        # positions of K and V across every layer, so its device
+        # footprint follows directly from the cache dtype and shape
+        # ([n_layers, n_blocks, block_size, n_kv_heads, head_dim]).
+        kb = self.pcache.k
+        self._block_bytes = (2 * kb.dtype.itemsize * kb.shape[0]
+                             * kb.shape[2] * kb.shape[3] * kb.shape[4])
+        self.metrics.gauge("kv.block_bytes").set(self._block_bytes)
+        self.metrics.gauge("kv.total_bytes").set(
+            self._block_bytes * total)
         self.prefix = (RadixPrefixCache(self.pool, block_size,
                                         metrics=self.metrics)
                        if prefix_cache else None)
@@ -375,6 +403,10 @@ class ServeEngine:
         self._tick = _tick
         self._chunk = _chunk
         self._set_row = _set_row
+        # Sentry baseline: all zeros pre-warmup.  The first compile of
+        # each program (0 -> 1) is legitimate; the sentry only counts
+        # growth BEYOND one signature per program.
+        self._jit_cache_seen = self.compile_cache_sizes()
 
     # -- introspection -----------------------------------------------------
 
@@ -401,11 +433,68 @@ class ServeEngine:
     def metrics_snapshot(self) -> dict:
         """Plain-dict snapshot of the engine's registry: counters,
         gauges, and the TTFT / TPOT / queue-wait / e2e histograms with
-        p50/p90/p99 — plus the windowed ``slo`` report — queryable with
-        no timeline attached."""
+        p50/p90/p99 — plus the windowed ``slo`` report, the ``memory``
+        accounting report, and (with profiling on) the rolling
+        ``profile`` phase breakdown — queryable with no timeline
+        attached."""
+        mem = self.memory_report()    # refreshes kv.*/mem.* gauges
         snap = self.metrics.snapshot()
         snap["slo"] = self.slo_report()
+        snap["memory"] = mem
+        if self.prof is not None:
+            snap["profile"] = self.prof.report()
         return snap
+
+    def memory_report(self) -> dict:
+        """Where the memory is: the paged KV pool by state (free /
+        referenced / cached, in blocks AND device bytes derived from the
+        cache dtype/shape) and the host-side observability footprint
+        (registry instruments, trace ring + SLO window, event-log file,
+        prefix radix index).  Also refreshes the ``kv.*`` / ``mem.*``
+        gauges so a scrape sees the same numbers."""
+        free = self.pool.free_count()
+        referenced = self.pool.ref_count()
+        cached = self.pool.cached_count()
+        bb = self._block_bytes
+        kv = {
+            "block_bytes": bb,
+            "total_bytes": bb * self.pcache.k.shape[1],
+            "free_blocks": free, "free_bytes": free * bb,
+            "referenced_blocks": referenced,
+            "referenced_bytes": referenced * bb,
+            "cached_blocks": cached, "cached_bytes": cached * bb,
+        }
+        # host side: getsizeof-level approximations — trend lines for
+        # leak spotting, not byte-exact accounting
+        trace_ring = sum(sys.getsizeof(t) for t in
+                         list(self.traces.values()))
+        trace_ring += len(self.slo) * 128    # SLO ring holds Trace refs
+        log = self.metrics.active_event_log()
+        try:
+            log_bytes = (os.path.getsize(log.path)
+                         if log is not None else 0)
+        except OSError:
+            log_bytes = 0
+        host = {
+            "registry_bytes": self.metrics.approx_footprint_bytes(),
+            "trace_ring_bytes": trace_ring,
+            "event_log_bytes": log_bytes,
+            "prefix_index_bytes": (self.prefix.approx_footprint_bytes()
+                                   if self.prefix is not None else 0),
+        }
+        self.metrics.gauge("kv.free_blocks").set(free)
+        self.metrics.gauge("kv.free_bytes").set(free * bb)
+        self.metrics.gauge("kv.referenced_blocks").set(referenced)
+        self.metrics.gauge("kv.referenced_bytes").set(referenced * bb)
+        self.metrics.gauge("kv.cached_blocks").set(cached)
+        self.metrics.gauge("kv.cached_bytes").set(cached * bb)
+        self.metrics.gauge("mem.registry_bytes").set(
+            host["registry_bytes"])
+        self.metrics.gauge("mem.trace_ring_bytes").set(trace_ring)
+        self.metrics.gauge("mem.event_log_bytes").set(log_bytes)
+        self.metrics.gauge("mem.prefix_index_bytes").set(
+            host["prefix_index_bytes"])
+        return {"kv": kv, "host": host}
 
     def slo_report(self) -> dict:
         """The SLO window's answer to "are we meeting SLOs *now*":
@@ -438,6 +527,20 @@ class ServeEngine:
             "  metrics=" + json.dumps(self.metrics_snapshot(),
                                       sort_keys=True),
         ]
+        bb = self._block_bytes
+        lines.append(
+            f"  kv bytes: block={bb} free={self.pool.free_count() * bb}"
+            f" referenced={self.pool.ref_count() * bb}"
+            f" cached={self.pool.cached_count() * bb}"
+            f" total={bb * self.pcache.k.shape[1]}")
+        if self.prof is not None:
+            rep = self.prof.report()
+            lines.append(
+                "  profile (mean ms over last "
+                f"{rep['n']} ticks): " + " ".join(
+                    f"{p}={rep['phases'][p]['mean_s'] * 1e3:.3f}"
+                    for p in profiler_mod.PHASES)
+                + f" tick={rep['tick']['mean_s'] * 1e3:.3f}")
         lines += ["  " + ln for ln in self.pool.state_lines()]
         if self.prefix is not None:
             lines.append(
@@ -630,8 +733,13 @@ class ServeEngine:
             if self.prefix is not None:
                 try:
                     self.faults.check("serve.cache", key=e.rid)
+                    t_cq = (0.0 if self.prof is None
+                            else time.perf_counter())
                     hit = self.prefix.acquire(
                         list(e.req.prompt) + list(e.prior))
+                    if self.prof is not None:
+                        self.prof.add("admit.cache_acquire", t_cq,
+                                      time.perf_counter())
                 except Exception as exc:
                     # quarantine: nothing was referenced, the index and
                     # every shared block are intact — only this request
@@ -953,6 +1061,12 @@ class ServeEngine:
         terminal state during the step."""
         self._finished = {}
         progress = 0
+        # Phase profiling is mark-based: each boundary charges the time
+        # since the previous one, so the phases tile the tick.  prof is
+        # None when disabled — the only cost then is these None tests.
+        prof = self.prof
+        if prof is not None:
+            prof.begin(self.step_index)
         # deadlines first: an expired request must not admit or tick
         now = None
         if (any(e.deadline is not None for e in self._queue)
@@ -978,6 +1092,8 @@ class ServeEngine:
                 e.wait_steps -= 1
                 progress += 1
             i += 1
+        if prof is not None:
+            prof.mark("expire")       # deadlines + queue bookkeeping
         admitted, starved_need = self._admit_ready()
         progress += admitted
         if starved_need is None:
@@ -992,6 +1108,7 @@ class ServeEngine:
                     self._starve_steps = 0
                     more, _ = self._admit_ready()  # head admits this step
                     progress += more
+        t_pf = 0.0 if prof is None else time.perf_counter()
         for slot, s in enumerate(self._slots):
             if s.state != PREFILL:
                 continue
@@ -1027,6 +1144,12 @@ class ServeEngine:
                 tr.prefill_chunks += 1
             if final:
                 s.state = DECODE      # joins this step's tick
+        if prof is not None:
+            # admit covers _admit_ready + preemption + the prefill
+            # windows; the dispatch portion is also attributed to the
+            # nested admit.prefill_dispatch sub-phase.
+            prof.add("admit.prefill_dispatch", t_pf, time.perf_counter())
+            prof.mark("admit")
         decoding = [i for i, s in enumerate(self._slots)
                     if s.state == DECODE]
         if decoding:
@@ -1036,7 +1159,14 @@ class ServeEngine:
                 tok, self.last_logits, self.pcache = self._tick(
                     self.params, self.pcache, self.last_logits,
                     jnp.asarray(active))
+                if prof is not None:
+                    prof.mark("decode_dispatch")
+                # np.asarray on the device token array is the readback
+                # boundary: everything the tick queued must complete
+                # first, so this wait is the device-time share.
                 tok_host = np.asarray(tok)
+                if prof is not None:
+                    prof.mark("device_sync")
             except Exception as exc:
                 # a whole-tick failure cannot be attributed to one row;
                 # quarantine every decoding row (transients replay)
@@ -1066,6 +1196,8 @@ class ServeEngine:
                     s.budget -= 1
                     if s.budget <= 0 or t == s.eos:
                         self._terminate(slot, OK)
+        if prof is not None:
+            prof.mark("sample_postprocess")
         if self.timeline is not None:
             self.timeline.counter(
                 "serving.scheduler", "SCHED",
@@ -1094,6 +1226,41 @@ class ServeEngine:
         if self.prefix is not None:
             self.metrics.gauge("serve.prefix_indexed_blocks").set(
                 self.prefix.indexed_blocks())
+        # KV pool accounting in blocks and bytes, refreshed per step so
+        # a scrape between snapshots still sees live occupancy.
+        bb = self._block_bytes
+        free_b = self.pool.free_count()
+        ref_b = self.pool.ref_count()
+        cached_b = self.pool.cached_count()
+        self.metrics.gauge("kv.free_blocks").set(free_b)
+        self.metrics.gauge("kv.free_bytes").set(free_b * bb)
+        self.metrics.gauge("kv.referenced_blocks").set(ref_b)
+        self.metrics.gauge("kv.referenced_bytes").set(ref_b * bb)
+        self.metrics.gauge("kv.cached_blocks").set(cached_b)
+        self.metrics.gauge("kv.cached_bytes").set(cached_b * bb)
+        # Retrace sentry: a jit cache that grows past one signature per
+        # program mid-serve means some host value leaked into a traced
+        # shape/dtype — the exact regression HVD001 lints for statically.
+        sizes = self.compile_cache_sizes()
+        grew = {k: (self._jit_cache_seen[k], v)
+                for k, v in sizes.items()
+                if v > self._jit_cache_seen[k] and v > 1}
+        self._jit_cache_seen = sizes
+        if grew:
+            n = sum(v - max(prev, 1) for prev, v in grew.values())
+            self.metrics.counter("serve.retrace").inc(n)
+            self.metrics.event(
+                "serve.retrace", step=self.step_index,
+                programs={k: {"before": prev, "after": v}
+                          for k, (prev, v) in grew.items()})
+            if self._retrace_fatal:
+                raise RuntimeError(
+                    f"retrace sentry: jit cache grew mid-serve "
+                    f"(HVD_TPU_RETRACE_FATAL=1) — "
+                    + ", ".join(f"{k}: {prev} -> {v}"
+                                for k, (prev, v) in sorted(grew.items()))
+                    + f"; a device program saw a new signature at step "
+                    f"{self.step_index}.  State:\n{self.state_dump()}")
         if self._verify_blocks:
             self._check_block_invariants()
         if self.pending() and progress == 0:
@@ -1109,6 +1276,8 @@ class ServeEngine:
             self._idle_steps = 0
         self._last_step_ts = time.monotonic()
         self.step_index += 1
+        if prof is not None:
+            prof.end()                # closes the bookkeeping phase
         return self._finished
 
     def run(self, requests: list[Request]) -> list[RequestResult]:
@@ -1147,9 +1316,14 @@ def measure_throughput(
     (``serve_ttft_p50_ms`` .. ``serve_e2e_p99_ms``),
     ``serve_metrics_overhead_pct`` (instrumented vs null-registry pass —
     the acceptance bound for the observability layer is < 2 %),
-    ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz vs
-    exporter off), ``serve_goodput`` (windowed SLO goodput after the
-    timed passes) and workload shape fields.
+    ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz) and
+    ``serve_profiler_overhead_pct`` (phase profiler on — bound < 3 %) —
+    both min-of-2 passes against an adjacent min-of-2 metrics-on base,
+    so inter-pass drift doesn't masquerade as overhead — with
+    ``serve_phase_pct`` / ``serve_phase_mean_ms`` per-phase breakdowns,
+    ``serve_goodput``
+    (windowed SLO goodput after the timed passes) and workload shape
+    fields.
     """
     if not requests:
         raise ValueError("empty workload")
@@ -1169,50 +1343,77 @@ def measure_throughput(
     reg = metrics_mod.MetricsRegistry(event_log=None)
     eng.metrics = reg
     preempt0 = eng.counters["preemptions"]
-    t0 = time.perf_counter()
-    out = eng.run(requests)
-    jax.block_until_ready(eng.pcache.k)
-    t_serve = time.perf_counter() - t0
-    assert [len(t) for t in out] == [len(t) for t in warm]
+
+    def _timed_pass() -> float:
+        t0 = time.perf_counter()
+        out = eng.run(requests)
+        jax.block_until_ready(eng.pcache.k)
+        dt = time.perf_counter() - t0
+        assert [len(t) for t in out] == [len(t) for t in warm]
+        return dt
+
+    t_serve = _timed_pass()
+    preemptions = eng.counters["preemptions"] - preempt0
     eng.metrics = metrics_mod.NULL
-    t0 = time.perf_counter()
-    off = eng.run(requests)
-    jax.block_until_ready(eng.pcache.k)
-    t_serve_off = time.perf_counter() - t0
-    assert [len(t) for t in off] == [len(t) for t in warm]
+    t_serve_off = _timed_pass()
     hist = {name: reg.histogram(name)
             for name in ("serve.ttft_s", "serve.tpot_s",
                          "serve.queue_wait_s", "serve.e2e_s")}
 
-    # fourth pass: exporter ON and actively scraped — a sidecar polling
-    # /metrics while the engine serves.  The delta vs the metrics-on
-    # pass prices the monitor itself (lock contention + render cost).
-    eng.metrics = metrics_mod.MetricsRegistry(event_log=None)
-    mon = monitor_mod.MonitorServer(eng.metrics, eng, port=0).start()
+    # Overhead arms.  A single pass A/B'd against a single earlier pass
+    # is noise-dominated at small shapes (allocator/scheduler drift
+    # between passes exceeds the effect being priced), so each arm runs
+    # INTERLEAVED with a fresh metrics-on base — base, arm, base, arm —
+    # and both sides take their min (the standard drift-robust
+    # estimator); the overheads are deltas between those mins.
+    mon_reg = metrics_mod.MetricsRegistry(event_log=None)
+    mon = monitor_mod.MonitorServer(mon_reg, eng, port=0).start()
+    scraping_on = threading.Event()
     stop_scraping = threading.Event()
 
     def _scrape_loop() -> None:
         import urllib.request
         url = f"http://{mon.host}:{mon.port}/metrics"
         while not stop_scraping.is_set():
-            try:
-                urllib.request.urlopen(url, timeout=1).read()
-            except OSError:
-                pass
-            stop_scraping.wait(0.01)
+            if scraping_on.is_set():
+                try:
+                    urllib.request.urlopen(url, timeout=1).read()
+                except OSError:
+                    pass
+                stop_scraping.wait(0.01)
+            else:
+                stop_scraping.wait(0.001)
 
     scraper = threading.Thread(target=_scrape_loop, daemon=True)
     scraper.start()
+    preg = metrics_mod.MetricsRegistry(event_log=None)
+    prof = profiler_mod.TickProfiler(preg, timeline=eng.timeline)
+    t_base = t_serve_mon = t_serve_prof = float("inf")
     try:
-        t0 = time.perf_counter()
-        mon_out = eng.run(requests)
-        jax.block_until_ready(eng.pcache.k)
-        t_serve_mon = time.perf_counter() - t0
+        for _ in range(2):
+            # base leg: metrics on, no exporter scrape, no profiler
+            eng.metrics = metrics_mod.MetricsRegistry(event_log=None)
+            t_base = min(t_base, _timed_pass())
+            # monitor leg: exporter ON and actively scraped — a sidecar
+            # polling /metrics while the engine serves prices the
+            # monitor itself (lock contention + render cost).
+            eng.metrics = mon_reg
+            scraping_on.set()
+            t_serve_mon = min(t_serve_mon, _timed_pass())
+            scraping_on.clear()
+            # profiler leg: per-tick phase timing ON (acceptance bound
+            # < 3 %); its report also says where tick time goes (the
+            # BENCH_r06+ breakdown).
+            eng.metrics = preg
+            eng.prof = prof
+            t_serve_prof = min(t_serve_prof, _timed_pass())
+            eng.prof = None
     finally:
+        eng.prof = None
         stop_scraping.set()
         scraper.join(timeout=5)
         mon.stop()
-    assert [len(t) for t in mon_out] == [len(t) for t in warm]
+    prof_report = prof.report()
 
     # static baseline: batches of n_slots, one compiled generate per
     # distinct batch budget (compiles excluded by per-batch warmup)
@@ -1249,7 +1450,7 @@ def measure_throughput(
         "serve_tokens_per_sec": n_tokens / t_serve,
         "static_tokens_per_sec": n_tokens / t_static,
         "serve_vs_static_ratio": t_static / t_serve,
-        "preemptions": eng.counters["preemptions"] - preempt0,
+        "preemptions": preemptions,
         "serve_ttft_p50_ms": hist["serve.ttft_s"].percentile(0.5) * 1e3,
         "serve_ttft_p99_ms": hist["serve.ttft_s"].percentile(0.99) * 1e3,
         "serve_tpot_p50_ms": hist["serve.tpot_s"].percentile(0.5) * 1e3,
@@ -1259,7 +1460,15 @@ def measure_throughput(
         "serve_metrics_overhead_pct":
             (t_serve - t_serve_off) / t_serve_off * 100.0,
         "monitor_overhead_pct":
-            (t_serve_mon - t_serve) / t_serve * 100.0,
+            (t_serve_mon - t_base) / t_base * 100.0,
+        "serve_profiler_overhead_pct":
+            (t_serve_prof - t_base) / t_base * 100.0,
+        "serve_phase_pct": {
+            p: prof_report["phases"][p]["pct_of_tick"]
+            for p in profiler_mod.PHASES},
+        "serve_phase_mean_ms": {
+            p: prof_report["phases"][p]["mean_s"] * 1e3
+            for p in profiler_mod.PHASES},
         "serve_goodput": eng.slo.goodput(),
         "tokens": n_tokens,
         "n_requests": len(requests),

@@ -267,10 +267,16 @@ def test_exporter_endpoints(world):
         code, ctype, body = _get(mon, "/snapshot")
         assert code == 200 and ctype == "application/json"
         snap = json.loads(body)
-        # Engine attached → the engine's view, SLO report embedded.
-        assert set(snap) == {"counters", "gauges", "histograms", "slo"}
+        # Engine attached → the engine's view, SLO + memory reports
+        # embedded ("profile" appears only with profiling on).
+        assert set(snap) == {"counters", "gauges", "histograms", "slo",
+                             "memory"}
         assert snap["counters"]["monitor.scrapes"] >= 1
         assert snap["slo"]["goodput"] == eng.slo.goodput()
+        assert snap["memory"]["kv"]["block_bytes"] == eng._block_bytes
+        # profiling off → /profile 404s with a hint
+        code, _, body = _get(mon, "/profile")
+        assert code == 404 and "HVD_TPU_PROFILE" in body
         code, _, body = _get(mon, "/healthz")
         hz = json.loads(body)
         assert code == 200 and hz["ok"] is True

@@ -1,0 +1,394 @@
+"""Serving profiler, retrace sentry, and memory accounting
+(horovod_tpu/profiler.py + the ServeEngine integration).
+
+The acceptance criteria, pinned:
+
+1. *Free and harmless*: profiling on vs off produces BIT-IDENTICAL
+   engine outputs, and ``compile_cache_sizes()`` stays at one signature
+   per program — the profiler never touches a traced value.
+2. *Phases tile the tick*: the top-level phase totals sum to the
+   profiler's measured tick wall time (coverage ~ 1.0), and that tick
+   total is within 10 % of an independently measured wall time for the
+   same steps.
+3. *Retrace sentry*: a deliberately unpinned jit call (a python int
+   where the engine always passes a device scalar) grows a program's
+   cache — the sentry bumps ``serve.retrace`` on the next step and
+   raises under the fatal knob.
+4. *Memory accounting*: ``kv.*`` byte gauges track the BlockPool
+   exactly (blocks x block_bytes) across admit / release-to-cache /
+   evict / preempt, and ``block_bytes`` matches the KV array's real
+   dtype/shape arithmetic.
+5. *Serving surface*: ``/profile`` over a real socket, snapshot and
+   state-dump embedding, event-log replay via tools/profile_report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu import profiler as profiler_mod
+from horovod_tpu.metrics import MetricsRegistry
+from horovod_tpu.models import llama
+from horovod_tpu.monitor import MonitorServer
+from horovod_tpu.profiler import PHASES, SUB_PHASES, TickProfiler
+from horovod_tpu.serving import OK, Request
+from horovod_tpu.serving_scheduler import ServeEngine
+
+pytestmark = pytest.mark.profile
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _reqs(n=4, pl=3, new=4, **kw):
+    rng = np.random.default_rng(2)
+    return [Request(prompt=[int(t) for t in
+                            rng.integers(1, 250, pl + (i % 3))],
+                    max_new_tokens=new, **kw)
+            for i in range(n)]
+
+
+def _engine(world, **kw):
+    cfg, params = world
+    kw.setdefault("metrics", MetricsRegistry(event_log=None))
+    kw.setdefault("monitor", False)
+    return ServeEngine(params, cfg, n_slots=2, max_len=32, chunk=8, **kw)
+
+
+# ---------------------------------------------------------------------------
+# TickProfiler unit behavior.
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_marks_tile_the_tick():
+    reg = MetricsRegistry(event_log=None)
+    prof = TickProfiler(reg, window=8)
+    for step in range(3):
+        prof.begin(step)
+        prof.mark("expire")
+        t0 = time.perf_counter()
+        prof.mark("admit")
+        prof.add("admit.cache_acquire", t0, time.perf_counter())
+        prof.end()                       # closes "bookkeeping"
+    rep = prof.report()
+    assert rep["n"] == rep["ticks"] == 3 and rep["window"] == 8
+    # tiling: per tick, the sum of top-level phases IS the tick time
+    tiled = sum(rep["phases"][p]["total_s"] for p in PHASES)
+    assert tiled == pytest.approx(rep["tick"]["total_s"], rel=1e-9)
+    assert rep["coverage"] == pytest.approx(1.0, rel=1e-9)
+    # sub-phases are reported but excluded from the coverage base
+    assert rep["phases"]["admit.cache_acquire"]["count"] == 3
+    assert rep["phases"]["admit.prefill_dispatch"]["count"] == 0
+    # every phase fed its histogram by literal name
+    assert reg.histogram("serve.phase.expire_s").count == 3
+    assert reg.histogram("serve.phase.tick_s").count == 3
+    assert reg.histogram("serve.phase.admit_cache_acquire_s").count == 3
+
+
+def test_profiler_window_semantics(monkeypatch):
+    reg = MetricsRegistry(event_log=None)
+    with pytest.raises(ValueError):
+        TickProfiler(reg, window=0)
+    # env default + tolerant parse of garbage
+    monkeypatch.setenv("HVD_TPU_PROFILE_WINDOW", "3")
+    prof = TickProfiler(reg)
+    assert prof.window == 3
+    monkeypatch.setenv("HVD_TPU_PROFILE_WINDOW", "not-a-number")
+    assert TickProfiler(reg).window == 256
+    # the ring keeps only the last `window` ticks; `ticks` keeps counting
+    for step in range(5):
+        prof.begin(step)
+        prof.end()
+    rep = prof.report()
+    assert rep["n"] == 3 and rep["ticks"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 1 + 2: bit-identical outputs, no new signatures, coverage.
+# ---------------------------------------------------------------------------
+
+
+def test_profile_on_off_parity_and_phase_sum(world):
+    reqs = _reqs(6)
+    off = _engine(world, prefix_cache=True)
+    out_off = off.run(reqs)
+    on = _engine(world, profile=True, prefix_cache=True)
+    t0 = time.perf_counter()
+    out_on = on.run(reqs)
+    wall = time.perf_counter() - t0
+    assert [list(a) for a in out_on] == [list(b) for b in out_off]
+    assert all(r.status == OK for r in out_on)
+    # one jit signature per program, profiling on — and no retraces seen
+    assert on.compile_cache_sizes() == {"tick": 1, "chunk": 1,
+                                        "set_row": 1}
+    assert on.metrics.counter("serve.retrace").value == 0
+    snap = on.metrics_snapshot()
+    assert "profile" in snap and "profile" not in off.metrics_snapshot()
+    rep = snap["profile"]
+    # phase sum within 10 % of measured wall step time (the tiling
+    # construction makes it exact vs the profiler's own tick clock;
+    # vs the OUTER wall clock only the between-step run() overhead
+    # separates them)
+    tiled = sum(rep["phases"][p]["total_s"] for p in PHASES)
+    assert tiled == pytest.approx(rep["tick"]["total_s"], rel=1e-6)
+    assert 0.9 <= rep["coverage"] <= 1.0 + 1e-9
+    assert rep["tick"]["total_s"] == pytest.approx(wall, rel=0.10)
+    # every phase + sub-phase is present in the report schema
+    assert set(rep["phases"]) == set(PHASES) | set(SUB_PHASES)
+    # cache-acquire sub-phase actually sampled (prefix cache was on)
+    assert rep["phases"]["admit.cache_acquire"]["count"] > 0
+    # state_dump carries the human-readable phase line
+    assert "profile (mean ms over last" in on.state_dump()
+    assert "kv bytes:" in on.state_dump()
+
+
+def test_profile_env_knob(world, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_PROFILE", "1")
+    eng = _engine(world)
+    assert eng.prof is not None
+    monkeypatch.delenv("HVD_TPU_PROFILE")
+    assert _engine(world).prof is None
+    # explicit argument beats the env
+    monkeypatch.setenv("HVD_TPU_PROFILE", "1")
+    assert _engine(world, profile=False).prof is None
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 3: the retrace sentry.
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_sentry_fires_on_unpinned_jit(world):
+    eng = _engine(world)
+    out = eng.run(_reqs(3))
+    assert all(r.status == OK for r in out)
+    assert eng.metrics.counter("serve.retrace").value == 0
+    # A deliberately unpinned call: the engine always passes the slot as
+    # a device int32 scalar; a python int is a new (weak-typed)
+    # signature, exactly the class of leak HVD001 lints for statically.
+    eng.pcache = eng._set_row(
+        eng.pcache, 0, jnp.asarray(eng._trash_row),
+        jnp.asarray(0, jnp.int32))
+    assert eng.compile_cache_sizes()["set_row"] == 2
+    eng.step()
+    assert eng.metrics.counter("serve.retrace").value == 1
+    # one-shot: the sentry baselines the new size, no double count
+    eng.step()
+    assert eng.metrics.counter("serve.retrace").value == 1
+
+
+def test_retrace_sentry_fatal(world, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RETRACE_FATAL", "1")
+    eng = _engine(world)
+    out = eng.run(_reqs(2))          # first compiles are NOT retraces
+    assert all(r.status == OK for r in out)
+    eng.pcache = eng._set_row(
+        eng.pcache, 1, jnp.asarray(eng._trash_row),
+        jnp.asarray(0, jnp.int32))
+    with pytest.raises(RuntimeError, match="retrace sentry"):
+        eng.step()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 4: KV/host memory accounting.
+# ---------------------------------------------------------------------------
+
+
+def test_block_bytes_matches_cache_shape(world):
+    eng = _engine(world)
+    k = eng.pcache.k
+    expect = (2 * k.dtype.itemsize
+              * k.shape[0] * k.shape[2] * k.shape[3] * k.shape[4])
+    assert eng._block_bytes == expect
+    mem = eng.memory_report()
+    assert mem["kv"]["block_bytes"] == expect
+    assert mem["kv"]["total_bytes"] == expect * k.shape[1]
+    assert eng.metrics.gauge("kv.block_bytes").value == expect
+
+
+def _assert_kv_gauges_match_pool(eng):
+    bb = eng._block_bytes
+    g = eng.metrics.gauge
+    assert g("kv.free_blocks").value == eng.pool.free_count()
+    assert g("kv.free_bytes").value == eng.pool.free_count() * bb
+    assert g("kv.referenced_blocks").value == eng.pool.ref_count()
+    assert g("kv.referenced_bytes").value == eng.pool.ref_count() * bb
+    assert g("kv.cached_blocks").value == eng.pool.cached_count()
+    assert g("kv.cached_bytes").value == eng.pool.cached_count() * bb
+
+
+def test_kv_gauges_track_pool_through_lifecycle(world):
+    # Overcommitted pool + preemption + prefix cache: admit, release-
+    # to-cache, evict, and preempt all happen, and after EVERY step the
+    # byte gauges are exactly blocks x block_bytes per pool state.
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=16, chunk=4,
+                      block_size=4, n_blocks=6, preempt_after=2,
+                      prefix_cache=True,
+                      metrics=MetricsRegistry(event_log=None),
+                      monitor=False)
+    shared = [5, 17, 42, 7, 9, 11, 13, 2]           # two full blocks
+    reqs = [Request(prompt=shared, max_new_tokens=8),        # 4 blocks
+            Request(prompt=[7, 8, 1, 3], max_new_tokens=6),  # starves
+            Request(prompt=shared, max_new_tokens=8),        # prefix hit
+            Request(prompt=shared, max_new_tokens=4)]
+    for r in reqs:
+        eng.submit(r)
+    saw_cached = False
+    steps = 0
+    while eng.pending() and steps < 300:
+        eng.step()
+        steps += 1
+        _assert_kv_gauges_match_pool(eng)
+        saw_cached = saw_cached or eng.pool.cached_count() > 0
+    assert not eng.pending()
+    assert eng.counters["preemptions"] >= 1, \
+        "workload did not exercise preemption"
+    assert saw_cached, "nothing was ever released to the prefix cache"
+    mem = eng.memory_report()
+    assert mem["kv"]["free_bytes"] == \
+        eng.pool.free_count() * eng._block_bytes
+    assert mem["host"]["registry_bytes"] > 0
+    assert mem["host"]["trace_ring_bytes"] > 0
+    assert mem["host"]["prefix_index_bytes"] > 0
+    assert eng.prefix.approx_footprint_bytes() == \
+        mem["host"]["prefix_index_bytes"]
+
+
+def test_event_log_bytes_accounted(world, tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    reg = MetricsRegistry(event_log=metrics_mod.EventLog(log))
+    eng = _engine(world, metrics=reg, profile=True)
+    eng.run(_reqs(2))
+    mem = eng.memory_report()
+    assert mem["host"]["event_log_bytes"] == os.path.getsize(log) > 0
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 5: the serving surface — /profile, replay, compare.
+# ---------------------------------------------------------------------------
+
+
+def test_profile_endpoint_over_socket(world):
+    import urllib.request
+    eng = _engine(world, profile=True)
+    mon = MonitorServer(eng.metrics, eng, port=0).start()
+    try:
+        eng.run(_reqs(3))
+        url = f"http://{mon.host}:{mon.port}/profile"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            rep = json.loads(r.read())
+        assert rep["n"] > 0
+        assert set(rep["phases"]) == set(PHASES) | set(SUB_PHASES)
+        # the scrape is the same report the engine computes
+        assert rep["ticks"] == eng.prof.report()["ticks"]
+    finally:
+        mon.stop()
+
+
+def test_event_log_replay_matches_live_report(world, tmp_path):
+    from tools.profile_report import (
+        compare_reports, load_report, render, report_from_events,
+    )
+    log = str(tmp_path / "events.jsonl")
+    reg = MetricsRegistry(event_log=metrics_mod.EventLog(log))
+    eng = _engine(world, metrics=reg, profile=True)
+    eng.run(_reqs(4))
+    live = eng.prof.report()
+    replay = load_report(log)
+    assert replay["n"] == live["n"]
+    for p in PHASES:
+        assert replay["phases"][p]["total_s"] == pytest.approx(
+            live["phases"][p]["total_s"], rel=1e-9)
+    assert replay["coverage"] == pytest.approx(live["coverage"],
+                                               rel=1e-6)
+    # --window replays only the tail
+    tail = report_from_events(
+        [json.loads(ln) for ln in open(log)], window=2)
+    assert tail["n"] == 2
+    # render never crashes and names every phase
+    text = render(replay)
+    for p in PHASES:
+        assert p in text
+    # a saved report round-trips through load_report, as does a full
+    # metrics_snapshot() dump (its "profile" key)
+    saved = tmp_path / "rep.json"
+    saved.write_text(json.dumps(live))
+    assert load_report(str(saved))["n"] == live["n"]
+    snap = tmp_path / "snap.json"
+    snap.write_text(json.dumps(eng.metrics_snapshot()))
+    assert load_report(str(snap))["n"] == live["n"]
+    # the regression gate: same-vs-same is clean, a doctored 2x admit
+    # regression past threshold+floor is flagged
+    assert not any(r["regressed"]
+                   for r in compare_reports(live, replay))
+    worse = json.loads(json.dumps(live))
+    worse["phases"]["admit"]["mean_s"] = \
+        live["phases"]["admit"]["mean_s"] * 2 + 1.0
+    rows = compare_reports(live, worse, threshold_pct=10, floor_ms=0.05)
+    flagged = {r["phase"] for r in rows if r["regressed"]}
+    assert flagged == {"admit"}
+    # the absolute floor silences sub-floor percent blowups
+    tiny_old = {"phases": {"x": {"mean_s": 1e-9}}}
+    tiny_new = {"phases": {"x": {"mean_s": 9e-9}}}
+    assert not any(r["regressed"]
+                   for r in compare_reports(tiny_old, tiny_new))
+
+
+def test_timeline_phase_spans_aggregate(world, tmp_path):
+    from horovod_tpu import timeline as timeline_mod
+    from tools.timeline_summary import load_events, summarize
+    path = str(tmp_path / "trace.json")
+    tl = timeline_mod.Timeline(path)
+    eng = _engine(world, timeline=tl, profile=True)
+    eng.run(_reqs(3))
+    tl.close()
+    s = summarize(load_events(path))
+    # phase/* spans moved into their own section, stripped of the prefix
+    assert set(PHASES) <= set(s["profile"])
+    assert not any(n.startswith("phase/") for n in s["spans"])
+    top_pct = sum(sp["pct"] for p, sp in s["profile"].items()
+                  if "." not in p)
+    assert top_pct == pytest.approx(100.0, rel=1e-6)
+    # spans carry real durations and close (no dangling ids)
+    for p in PHASES:
+        assert s["profile"][p]["open"] == 0
+    # unconditional boundaries emit one span per tick; the decode pair
+    # only on steps that actually ticked the device
+    for p in ("expire", "admit", "sample_postprocess", "bookkeeping"):
+        assert s["profile"][p]["count"] == eng.step_index
+    for p in ("decode_dispatch", "device_sync"):
+        assert 1 <= s["profile"][p]["count"] <= eng.step_index
+
+
+def test_profiler_overhead_and_registry_cache(world):
+    # The rendered-exposition cache: unchanged registry -> the SAME
+    # string object (no re-render); any instrument write invalidates;
+    # and the monitor's own scrape counter does NOT invalidate (its
+    # generation cell is private), so back-to-back scrapes are cheap.
+    reg = MetricsRegistry(event_log=None)
+    reg.counter("serve.steps").inc()
+    a = reg.to_prometheus()
+    assert reg.to_prometheus() is a
+    reg.counter("serve.steps").inc()
+    b = reg.to_prometheus()
+    assert b is not a
+    mon = MonitorServer(reg, port=0)
+    mon._scrapes.inc()
+    assert reg.to_prometheus() is b
+    assert reg.snapshot()["counters"]["monitor.scrapes"] == 1
+    mon._httpd.server_close()

@@ -21,8 +21,10 @@ reference timeline.cc:98-132 parity), aggregated counter (``ph: "C"``)
 series — the serving scheduler's SCHED/LIFECYCLE/PREFIX tracks: final
 values plus the delta and sample count across the trace — and
 per-request async spans (the engine's ``REQ`` ``b``/``e`` pairs, one id
-per request).  ``--json`` dumps the whole summary dict as JSON for
-scripting.
+per request).  The serving profiler's ``phase/<name>`` spans (one id per
+tick, ``HVD_TPU_PROFILE=1``) get their own per-phase table with each
+top-level phase's share of the tiled tick time.  ``--json`` dumps the
+whole summary dict as JSON for scripting.
 """
 
 from __future__ import annotations
@@ -188,12 +190,24 @@ def summarize(events: list[dict]) -> dict:
         }
         for name, ds in span_durs.items()
     }
+    # TickProfiler spans ("phase/<name>", id = step) get their own
+    # section: stripped of the prefix, with each top-level phase's share
+    # of the tiled tick time (dotted names are nested sub-phases —
+    # contained in their parent, so excluded from the 100 % base).
+    profile = {name[len("phase/"):]: spans.pop(name)
+               for name in [n for n in spans if n.startswith("phase/")]}
+    tiled_us = sum(sp["total_us"] for p, sp in profile.items()
+                   if "." not in p)
+    for p, sp in profile.items():
+        sp["pct"] = (100.0 * sp["total_us"] / tiled_us
+                     if tiled_us else 0.0)
     return {
         "tensors": per_tensor,
         "phase_totals": dict(phase_totals),
         "ticks": dict(ticks),
         "counters": counters,
         "spans": spans,
+        "profile": profile,
         "unbalanced": unbalanced,
     }
 
@@ -253,6 +267,14 @@ def main(argv=None) -> int:
             print(f"  {name:24s} n={sp['count']:5d} open={sp['open']:3d} "
                   f"mean {sp['mean_us'] / 1e3:8.2f}ms "
                   f"max {sp['max_us'] / 1e3:8.2f}ms")
+    if s["profile"]:
+        print("\nprofiler phases (ms):")
+        for name, sp in sorted(s["profile"].items(),
+                               key=lambda kv: -kv[1]["total_us"]):
+            print(f"  {name:24s} n={sp['count']:5d} "
+                  f"total {sp['total_us'] / 1e3:10.2f} "
+                  f"mean {sp['mean_us'] / 1e3:8.3f} "
+                  f"max {sp['max_us'] / 1e3:8.3f}  {sp['pct']:5.1f}%")
 
     rows = sorted(
         s["tensors"].items(),
