@@ -652,6 +652,87 @@ def _bench_serve_prefix(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_spec(hvd, on_tpu: bool) -> dict:
+    """Self-drafting speculative decode throughput (extras arm, TPU
+    only): the ServeEngine with ``spec=True`` vs. the same engine plain,
+    on two workloads bracketing the prompt-lookup drafter's range — a
+    lookup-friendly one whose continuations repeat (the grounded
+    summarize/code-edit regime the drafter exists for) and a
+    lookup-hostile one of incompressible random streams, which prices
+    the fixed ``(draft_k + 1)``-wide verify tick when nothing is ever
+    accepted.  The acceptance bar: ``serve_spec_vs_plain_ratio > 1`` on
+    the friendly workload; the hostile ratio is reported as the honest
+    overhead floor, not gated.  Parity is asserted inside the helper:
+    spec-on outputs are bit-identical to spec-off (and hence to solo
+    greedy decode) on both workloads.
+
+    The friendly workload doctors the model rather than the prompts:
+    with ``lm_head`` zeroed every logit ties and greedy argmax pins one
+    constant continuation, making the served *stream* (not just the
+    prompt) perfectly repetitive — the property the drafter feeds on —
+    while the per-tick matmul cost is unchanged, so the on/off timing
+    comparison stays fair."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_spec_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        n_slots, max_len, chunk = 2, 32, 4
+        n_reqs, prompt_len, new_toks, draft_k = 6, 6, 20, 4
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        n_reqs, prompt_len, new_toks, draft_k = 32, 48, 128, 4
+    params = llama.init_params(cfg, jax.random.key(0))
+    flat = dict(params)
+    flat["lm_head"] = jnp.zeros_like(flat["lm_head"])
+    friendly_params = flat
+    rng = np.random.RandomState(29)
+    # Friendly prompts end in a run of the constant token the doctored
+    # model emits, so the suffix n-gram matches from the first round.
+    friendly = [
+        [int(t) for t in rng.randint(1, cfg.vocab_size,
+                                     size=prompt_len - 3)] + [0, 0, 0]
+        for _ in range(n_reqs)]
+    hostile = [
+        [int(t) for t in rng.randint(1, cfg.vocab_size, size=prompt_len)]
+        for _ in range(n_reqs)]
+    out: dict = {}
+    for tag, p, prompts in (("", friendly_params, friendly),
+                            ("_hostile", params, hostile)):
+        reqs = [Request(prompt=pr, max_new_tokens=new_toks)
+                for pr in prompts]
+        r = measure_spec_throughput(p, cfg, reqs, n_slots=n_slots,
+                                    max_len=max_len, chunk=chunk,
+                                    draft_k=draft_k)
+        out.update({
+            f"serve_spec{tag}_tokens_per_sec": round(
+                r["serve_spec_tokens_per_sec"], 1),
+            f"serve_spec{tag}_plain_tokens_per_sec": round(
+                r["serve_spec_plain_tokens_per_sec"], 1),
+            f"serve_spec{tag}_vs_plain_ratio": round(
+                r["serve_spec_vs_plain_ratio"], 3),
+            f"serve_spec{tag}_accepted_per_round": round(
+                r["serve_spec_accepted_per_round"], 3),
+        })
+    out["serve_spec_shape"] = (
+        f"s{n_slots}_len{max_len}_chunk{chunk}_k{draft_k}_"
+        f"new{new_toks}_req{n_reqs}")
+    return out
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1156,7 +1237,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # newer arms.
     for fn in (_bench_fusion, _bench_serving,
                _bench_serving_overcommit, _bench_serve_prefix,
-               _bench_resnet101_big_batch,
+               _bench_serve_spec, _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):
         if time.monotonic() - _T_START > budget_s:

@@ -22,6 +22,10 @@ Named sites currently wired:
                    request id) — fires BEFORE the radix match takes
                    any block references, so a fault quarantines to the
                    one request while every shared block stays intact
+``serve.draft``    per drafting row per spec tick (key = request id) —
+                   a firing drafter degrades that row to plain decode
+                   for the round; drafting is an optimization, so the
+                   request itself never fails or retries
 ``data.producer``  per batch assembled by the
                    :class:`~horovod_tpu.data.ShardedLoader` prefetch
                    thread (key = batch index)

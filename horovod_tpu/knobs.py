@@ -68,6 +68,8 @@ ENV_KNOBS = (
      "Enable 64-bit jax types for the torch-compat surface."),
     ("HVD_TPU_BENCH_CACHE", "",
      "Directory for cached benchmark baselines (default: repo-local)."),
+    ("HVD_TPU_DRAFT_K", "4",
+     "Draft tokens proposed per slot per tick when speculation is on."),
     ("HVD_TPU_EVENT_LOG", "",
      "JSONL request-lifecycle event-log output path."),
     ("HVD_TPU_FLASH_BWD", "pallas",
@@ -82,8 +84,12 @@ ENV_KNOBS = (
      "Ticks in the profiler's rolling per-phase report window."),
     ("HVD_TPU_RETRACE_FATAL", "0",
      "Raise when the retrace sentry sees a jit cache grow mid-serve."),
+    ("HVD_TPU_SCHED_POLICY", "fifo",
+     "ServeEngine scheduler policy: fifo, priority, or edf."),
     ("HVD_TPU_SLO_E2E_S", "0",
      "End-to-end latency SLO in seconds for goodput (0 = no SLO)."),
+    ("HVD_TPU_SPEC", "0",
+     "Self-drafting (prompt-lookup) speculative decode in ServeEngine."),
     ("HVD_TPU_STRAGGLER_WARN_S", "1.0",
      "Step-lag threshold in seconds before a straggler warning."),
     ("HVD_TPU_VERIFY_BLOCKS", "0",

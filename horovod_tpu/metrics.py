@@ -74,6 +74,8 @@ TIMELINE_COUNTER_SERIES: dict[str, tuple[str, ...]] = {
     "LIFECYCLE": ("preemptions", "timeouts", "cancellations",
                   "rejections", "retries", "failures"),
     "PREFIX": ("hits", "blocks_reused", "tokens_skipped", "evictions"),
+    # serving_scheduler.ServeEngine with spec=True, per step
+    "SPEC": ("rounds", "row_rounds", "proposed", "accepted"),
     # serving.speculative_generate, per verify round
     "ACCEPT": ("accepted", "rows"),
 }
@@ -85,6 +87,7 @@ FAULT_SITES: tuple[str, ...] = (
     "serve.prefill",
     "serve.tick",
     "serve.cache",
+    "serve.draft",
     "data.producer",
 )
 
@@ -139,6 +142,13 @@ METRIC_HELP: dict[str, str] = {
     "serve.failures": "Requests terminated FAILED after exhausting retries",
     "serve.prefix_indexed_blocks": "KV pages indexed by the radix prefix cache",
     "serve.retrace": "Jit cache growths detected mid-serve by the retrace sentry",
+    # serve.spec.* — self-drafting speculation (spec=True engines)
+    "serve.spec.rounds": "Speculative verify ticks executed (>= 1 decoding row)",
+    "serve.spec.row_rounds": "Per-row verify rounds (decoding rows summed over spec ticks)",
+    "serve.spec.proposed": "Draft tokens proposed by the prompt-lookup drafter",
+    "serve.spec.accepted": "Draft tokens accepted by greedy longest-prefix verification",
+    "serve.spec.accepted_per_round": "Accepted draft tokens per decoding row per verify round",
+    "serve.spec.draft_faults": "Drafter faults degraded to plain decode (row unaffected)",
     # serve.phase.* — TickProfiler per-tick phase histograms (seconds);
     # the top-level phases tile step() wall time, the admit_* sub-phases
     # nest inside admit, and tick_s is the whole step.
@@ -146,8 +156,10 @@ METRIC_HELP: dict[str, str] = {
     "serve.phase.admit_s": "Tick phase: admission, preemption, and prefill windows",
     "serve.phase.admit_cache_acquire_s": "Admit sub-phase: prefix-cache longest-prefix acquire",
     "serve.phase.admit_prefill_dispatch_s": "Admit sub-phase: chunked-prefill window dispatch",
+    "serve.phase.draft_s": "Tick phase: prompt-lookup draft proposal (spec engines)",
     "serve.phase.decode_dispatch_s": "Tick phase: host time dispatching the decode tick",
     "serve.phase.device_sync_s": "Tick phase: blocking token readback (device wait)",
+    "serve.phase.verify_s": "Tick phase: acceptance + token emission (spec engines)",
     "serve.phase.sample_postprocess_s": "Tick phase: per-slot token handling and retirement",
     "serve.phase.bookkeeping_s": "Tick phase: counters, gauges, sentry, watchdog",
     "serve.phase.tick_s": "Whole engine step wall time as the profiler measures it",

@@ -842,6 +842,50 @@ def decode_chunk_paged(
     return logits, pcache._replace(k=ks, v=vs, length=pos + adv)
 
 
+def spec_verify_paged(
+    params: dict, cfg: LlamaConfig, pcache: PagedKVCache,
+    last_logits: jax.Array, drafts: jax.Array, active: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    """One batched self-speculation verify round over the paged pool:
+    every row argmaxes its last logits into ``tok`` and decodes the
+    fixed ``(K + 1)``-wide chunk ``[tok, d_1..d_K]`` in ONE
+    :func:`decode_chunk_paged` dispatch; greedy longest-matching-prefix
+    acceptance is computed IN-PROGRAM (a cumprod of per-position
+    matches), so the host never round-trips between dispatch and the
+    length advance.  ``drafts`` [B, K] pads with ``-1`` — argmax preds
+    are always >= 0, so pads can never be accepted — and ``active`` [B]
+    gates the advance exactly as the plain tick's does.
+
+    Rollback of rejected positions is the per-row ``length`` alone: the
+    chunk's K/V writes beyond ``length + 1 + accept`` are stale garbage
+    in the row's own private frontier blocks (or trash, for inactive
+    rows), masked by every reader and overwritten before the frontier
+    reaches them — the same write-before-read invariant the slot pool
+    already relies on, so no block-table or cache surgery is needed.
+
+    With greedy acceptance every emitted token is the target's own
+    argmax (accepted ``d_i`` equals ``preds[i-1]`` by construction), so
+    the output stream is bit-identical to solo greedy :func:`generate`
+    no matter what the drafter proposed.  Returns ``(tok, accept,
+    next_logits, pcache)``: the unconditional token [B], accepted draft
+    counts [B], the logits following each row's last accepted token
+    [B, V] (seeding the next round), and the advanced cache.
+    """
+    b, k = drafts.shape
+    tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)       # [B]
+    chunk = jnp.concatenate([tok[:, None], drafts], axis=1)   # [B, K+1]
+    hold = jnp.zeros((b,), jnp.int32)
+    logits, pcache = decode_chunk_paged(
+        params, chunk, cfg, pcache, advance=hold)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [B, K+1]
+    match = (drafts == preds[:, :k]).astype(jnp.int32)
+    accept = jnp.sum(jnp.cumprod(match, axis=1), axis=1)           # [B]
+    adv = jnp.asarray(active, jnp.int32) * (1 + accept)
+    pcache = pcache._replace(length=pcache.length + adv)
+    next_logits = logits[jnp.arange(b), accept]                 # [B, V]
+    return tok, accept, next_logits, pcache
+
+
 def decode_chunk_paged_row(
     params: dict, tokens: jax.Array, cfg: LlamaConfig,
     pcache: PagedKVCache, slot: jax.Array, *, new_length: jax.Array,

@@ -48,8 +48,17 @@ from horovod_tpu import metrics as metrics_mod
 
 #: Top-level phases in ``step()`` order.  They TILE the tick — each is
 #: measured boundary-to-boundary, so their sum equals the tick wall time.
+#: Every engine produces exactly these; schema consumers (replay,
+#: timeline aggregation, the bench arm) may rely on their presence.
 PHASES = ("expire", "admit", "decode_dispatch", "device_sync",
           "sample_postprocess", "bookkeeping")
+
+#: Extra top-level phases that fire only on spec-enabled engines
+#: (``draft`` before dispatch, ``verify`` in place of part of
+#: ``sample_postprocess``).  They tile the tick exactly like
+#: :data:`PHASES` but are surfaced in ``report()`` only once observed,
+#: so non-spec engines keep the PR-7 report schema byte-for-byte.
+SPEC_PHASES = ("draft", "verify")
 
 #: Nested sub-phases (explicit intervals inside a parent phase).  They
 #: overlap their parent, so coverage math skips them.
@@ -107,15 +116,18 @@ class TickProfiler:
                 metrics.histogram("serve.phase.admit_cache_acquire_s"),
             "admit.prefill_dispatch":
                 metrics.histogram("serve.phase.admit_prefill_dispatch_s"),
+            "draft": metrics.histogram("serve.phase.draft_s"),
             "decode_dispatch":
                 metrics.histogram("serve.phase.decode_dispatch_s"),
             "device_sync": metrics.histogram("serve.phase.device_sync_s"),
+            "verify": metrics.histogram("serve.phase.verify_s"),
             "sample_postprocess":
                 metrics.histogram("serve.phase.sample_postprocess_s"),
             "bookkeeping": metrics.histogram("serve.phase.bookkeeping_s"),
             "tick": metrics.histogram("serve.phase.tick_s"),
         }
-        assert set(self._hists) == set(PHASES) | set(SUB_PHASES) | {"tick"}
+        assert set(self._hists) == (set(PHASES) | set(SPEC_PHASES)
+                                    | set(SUB_PHASES) | {"tick"})
 
     # -- hot path (engine thread) ------------------------------------------
 
@@ -179,7 +191,12 @@ class TickProfiler:
         tick_total = sum(ticks)
         phases: dict[str, dict] = {}
         tiled = 0.0
-        for phase in PHASES + SUB_PHASES:
+        # Spec phases (and any future mark names) join the report only
+        # once a tick actually recorded them — non-spec engines keep
+        # the fixed PHASES schema.
+        extra = sorted({k for it in items for k in it}
+                       - set(PHASES) - set(SUB_PHASES) - {"tick"})
+        for phase in PHASES + tuple(extra) + SUB_PHASES:
             vals = [it[phase] for it in items if phase in it]
             total = sum(vals)
             phases[phase] = {
@@ -190,7 +207,7 @@ class TickProfiler:
                 "pct_of_tick": (100.0 * total / tick_total
                                 if tick_total else 0.0),
             }
-            if phase in PHASES:
+            if phase not in SUB_PHASES:
                 tiled += total
         return {
             "window": self.window,

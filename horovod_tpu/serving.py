@@ -78,9 +78,17 @@ class Request:
     ``slo_s``: SOFT end-to-end latency target for SLO accounting — a
     request finishing OK but slower than this counts against the
     engine's windowed ``serve.goodput``
-    (:class:`~horovod_tpu.monitor.SLOWindow`).  Unlike ``deadline_s``
-    it never changes scheduling or the result: the request still
-    completes and returns its tokens."""
+    (:class:`~horovod_tpu.monitor.SLOWindow`).  Under the engine's
+    ``edf`` scheduler policy (:mod:`horovod_tpu.scheduling`) the
+    derived absolute deadline ALSO orders admission and picks
+    preemption victims; with the default ``fifo`` policy it never
+    changes scheduling or the result: the request still completes and
+    returns its tokens.
+
+    ``priority``: scheduling weight for the engine's ``priority``
+    policy (higher admits first, lower is preempted first; 0 default).
+    Like ``slo_s`` it never affects any request's *output* — scheduler
+    policies reorder waiting, not tokens."""
 
     prompt: list[int]
     max_new_tokens: int
@@ -91,6 +99,7 @@ class Request:
     deadline_s: float | None = None
     max_queue_steps: int | None = None
     slo_s: float | None = None
+    priority: int = 0
 
 
 # Terminal request statuses (ServeEngine request lifecycle).

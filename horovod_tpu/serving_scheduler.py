@@ -31,11 +31,15 @@ schedulers treat as first-class scheduler transitions, not crashes):
   (step-counted admission budget → ``REJECTED``) bound waiting;
 * **KV-pressure preemption with replay**: when the queue head has
   starved ``preempt_after`` consecutive steps on an overcommitted block
-  pool, the youngest decoding row is preempted — blocks freed, request
-  re-queued with ``prompt + out`` as the replay prompt.  Greedy
-  determinism makes the resumed output bit-identical to the
-  uninterrupted run, and everything rides the existing ``_set_row``
-  program so no new jit signatures appear;
+  pool, a decoding row is preempted — blocks freed, request re-queued
+  with ``prompt + out`` as the replay prompt.  Which row is the victim
+  (and in what order the queue admits) is a pluggable
+  :class:`~horovod_tpu.scheduling.SchedulerPolicy` — FIFO (default,
+  bit-compatible: evicts the youngest), priority, or EDF (evicts the
+  slack-richest).  Greedy determinism makes the resumed output
+  bit-identical to the uninterrupted run whoever is chosen, and
+  everything rides the existing ``_set_row`` program so no new jit
+  signatures appear;
 * **poison-request quarantine**: a raising prefill window or decode-tick
   readback fails only the implicated request — transient faults get
   bounded step-counted retries with exponential backoff (decode retries
@@ -43,8 +47,9 @@ schedulers treat as first-class scheduler transitions, not crashes):
   exception.  All other rows keep serving;
 * deterministic fault injection via :mod:`horovod_tpu.faults` sites
   ``serve.admit`` / ``serve.prefill`` / ``serve.tick`` /
-  ``serve.cache``, and a no-progress watchdog that raises with a full
-  scheduler-state dump instead of spinning ``run()`` forever.
+  ``serve.cache`` / ``serve.draft``, and a no-progress watchdog that
+  raises with a full scheduler-state dump instead of spinning
+  ``run()`` forever.
 
 Shared-prefix KV reuse (``prefix_cache=True``; PagedAttention block
 sharing + RadixAttention-style automatic indexing — see
@@ -66,9 +71,29 @@ sharing + RadixAttention-style automatic indexing — see
   release-to-cache too, so its replay re-admits through the cache and
   is nearly free;
 * none of it adds device programs: cache hits change block-table
-  *data*, never shapes — the same three jit signatures serve, pinned
-  by ``compile_cache_sizes()``, and every output stays bit-identical
-  to the cache-off solo greedy run.
+  *data*, never shapes — the same jit signatures serve, pinned by
+  ``compile_cache_sizes()``, and every output stays bit-identical to
+  the cache-off solo greedy run.
+
+Self-drafting speculative decode (``spec=True`` / ``HVD_TPU_SPEC=1``;
+prompt-lookup decoding in the continuous batch — see
+:mod:`horovod_tpu.drafting` and
+:func:`~horovod_tpu.models.llama.spec_verify_paged`):
+
+* each decoding slot drafts up to ``draft_k`` tokens per tick from an
+  incremental n-gram index over its own prompt + output — no draft
+  model, no extra forward pass, pure host work (the ``draft``
+  profiler phase);
+* ONE wide verify program replaces the 1-wide tick: every row decodes
+  a fixed ``(draft_k + 1)``-window per dispatch, greedy
+  longest-matching-prefix acceptance runs on device, and the per-row
+  cache length advances by ``1 + accepted`` — rejected positions roll
+  back by the length alone (write-before-read: the frontier rewrites
+  them before they can be read);
+* acceptance only ever keeps the model's own argmax, so spec on/off
+  is bit-identical to the solo greedy run for any draft quality, and
+  ``compile_cache_sizes()`` stays frozen at one signature per program
+  (``spec_tick`` replacing ``tick``).
 
 Scheduler invariants:
 
@@ -83,7 +108,10 @@ Scheduler invariants:
    ``tests/test_serving_faults.py``).
 3. *Fixed signature*: host state (queue, slot states, free blocks) makes
    every decision; device programs only ever see [n_slots]-shaped data.
-   Preempt/requeue/cancel/timeout paths reuse the same three programs.
+   Preempt/requeue/cancel/timeout paths reuse the same programs, and
+   scheduler policies (:mod:`horovod_tpu.scheduling`) only reorder
+   host decisions — invariant 2 makes any admission order or victim
+   choice output-preserving.
 
 The engine is greedy-only; sampling pools stay on
 :class:`~horovod_tpu.serving.ContinuousBatcher`.
@@ -104,10 +132,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu import drafting as drafting_mod
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
 from horovod_tpu import monitor as monitor_mod
 from horovod_tpu import profiler as profiler_mod
+from horovod_tpu import scheduling as scheduling_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import RadixPrefixCache
@@ -147,6 +177,7 @@ class _QueueEntry:
     wait_steps: int = 0
     queued_steps: int = 0
     deadline: float | None = None
+    slo_deadline: float | None = None    # enqueue + slo_s (EDF policy)
 
 
 @dataclasses.dataclass
@@ -169,7 +200,9 @@ class _Slot:
     retries: int = 0
     wait_steps: int = 0                  # prefill-retry backoff
     deadline: float | None = None
+    slo_deadline: float | None = None    # enqueue + slo_s (EDF policy)
     admit_seq: int = -1                  # monotonic; max = youngest row
+    draft: "drafting_mod.NgramDraftState | None" = None
 
 
 class ServeEngine:
@@ -234,6 +267,27 @@ class ServeEngine:
     path is unchanged.  Set ``HVD_TPU_VERIFY_BLOCKS=1`` to walk the
     block tables after every step asserting refcount consistency (debug
     aid; O(slots * blocks) host work per step).
+
+    ``spec`` / ``draft_k``: self-drafting speculative decode — each
+    decoding row's prompt-lookup drafter
+    (:class:`~horovod_tpu.drafting.NgramDraftState`) proposes up to
+    ``draft_k`` tokens per tick from the request's own history and ONE
+    always-``(draft_k + 1)``-wide batched verify program
+    (:func:`~horovod_tpu.models.llama.spec_verify_paged`) decodes every
+    row's chunk with per-row greedy longest-prefix acceptance; rejected
+    positions roll back by the row's length alone (write-before-read).
+    One extra jit signature for the life of the server (``spec_tick``
+    replaces ``tick`` in ``compile_cache_sizes()``), every output stays
+    bit-identical to solo greedy generate, and a round can emit up to
+    ``1 + draft_k`` tokens per row.  ``None`` reads ``HVD_TPU_SPEC`` /
+    ``HVD_TPU_DRAFT_K`` (off / 4).
+
+    ``policy``: admission-order + preemption-victim policy — a
+    :class:`~horovod_tpu.scheduling.SchedulerPolicy` instance, a name
+    (``fifo`` / ``priority`` / ``edf``), or ``None`` to read
+    ``HVD_TPU_SCHED_POLICY``.  FIFO is bit-compatible with the
+    pre-policy engine; policies reorder who waits and who is evicted,
+    never any request's tokens (scheduler invariant 2).
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
@@ -252,7 +306,11 @@ class ServeEngine:
                  slo_window: int = 256,
                  slo_e2e_s: float | None = None,
                  profile: bool | None = None,
-                 profile_window: int | None = None):
+                 profile_window: int | None = None,
+                 spec: bool | None = None,
+                 draft_k: int | None = None,
+                 policy: "scheduling_mod.SchedulerPolicy | str | None"
+                     = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
                              f"{max_len}]")
@@ -273,6 +331,30 @@ class ServeEngine:
         self.watchdog_steps = watchdog_steps
         self.faults = faults if faults is not None else faults_mod.DEFAULT
         self.metrics = metrics if metrics is not None else metrics_mod.DEFAULT
+        # Scheduler policy (admission order + preemption victim): FIFO
+        # default is bit-compatible with the pre-policy engine.
+        self.policy = scheduling_mod.resolve_policy(policy)
+        # Self-drafting speculation: env-driven when unset.
+        if spec is None:
+            spec = os.environ.get("HVD_TPU_SPEC", "") == "1"
+        if draft_k is None:
+            raw = os.environ.get("HVD_TPU_DRAFT_K", "")
+            draft_k = int(raw) if raw else drafting_mod.DEFAULT_DRAFT_K
+        if spec and draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+        self.spec = bool(spec)
+        self.draft_k = int(draft_k)
+        self.spec_counters = {"rounds": 0, "row_rounds": 0,
+                              "proposed": 0, "accepted": 0}
+        if self.spec:
+            # Registered up front (literal names — the HVD005 contract)
+            # so spec snapshots are schema-stable from step 0.
+            self.metrics.counter("serve.spec.rounds")
+            self.metrics.counter("serve.spec.row_rounds")
+            self.metrics.counter("serve.spec.proposed")
+            self.metrics.counter("serve.spec.accepted")
+            self.metrics.counter("serve.spec.draft_faults")
+            self.metrics.histogram("serve.spec.accepted_per_round")
         # Register the latency histograms up front so metrics_snapshot()
         # is schema-stable from step 0 (empty histograms report zeros).
         for h in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
@@ -400,6 +482,21 @@ class ServeEngine:
                 block_table=pcache.block_table.at[slot].set(row),
                 length=pcache.length.at[slot].set(length))
 
+        if self.spec:
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def _spec_tick(params, pcache, last_logits, drafts, active):
+                # the always-wide speculative tick: one (draft_k+1)-wide
+                # verify for the whole pool, acceptance and the gated
+                # length advance computed in-program so the host reads
+                # back tokens AND accepted counts in one sync.  Replaces
+                # _tick entirely on a spec engine — still one signature
+                # per program for the life of the server.
+                return llama.spec_verify_paged(
+                    params, cfg, pcache, last_logits, drafts, active)
+
+            self._spec_tick = _spec_tick
+        else:
+            self._spec_tick = None
         self._tick = _tick
         self._chunk = _chunk
         self._set_row = _set_row
@@ -412,12 +509,17 @@ class ServeEngine:
 
     def compile_cache_sizes(self) -> dict[str, int]:
         """Per-program jit cache entry counts — the no-retrace pin:
-        admission/recycling/preemption must keep every count constant."""
-        return {
+        admission/recycling/preemption must keep every count constant.
+        A spec engine adds the ``spec_tick`` key (its always-wide verify
+        program, which replaces ``tick`` so that count stays 0)."""
+        sizes = {
             "tick": self._tick._cache_size(),
             "chunk": self._chunk._cache_size(),
             "set_row": self._set_row._cache_size(),
         }
+        if self._spec_tick is not None:
+            sizes["spec_tick"] = self._spec_tick._cache_size()
+        return sizes
 
     def free_block_count(self) -> int:
         return len(self._free_blocks)
@@ -539,7 +641,7 @@ class ServeEngine:
                 "  profile (mean ms over last "
                 f"{rep['n']} ticks): " + " ".join(
                     f"{p}={rep['phases'][p]['mean_s'] * 1e3:.3f}"
-                    for p in profiler_mod.PHASES)
+                    for p in rep["phases"] if "." not in p)
                 + f" tick={rep['tick']['mean_s'] * 1e3:.3f}")
         lines += ["  " + ln for ln in self.pool.state_lines()]
         if self.prefix is not None:
@@ -609,8 +711,10 @@ class ServeEngine:
         self._next_id += 1
         now = time.monotonic()
         deadline = None if req.deadline_s is None else now + req.deadline_s
+        slo_deadline = None if req.slo_s is None else now + req.slo_s
         self._queue.append(_QueueEntry(rid=rid, req=req,
-                                       deadline=deadline))
+                                       deadline=deadline,
+                                       slo_deadline=slo_deadline))
         self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
                                  enqueue_step=self.step_index)
         self._slo_targets[rid] = req.slo_s
@@ -687,7 +791,12 @@ class ServeEngine:
         s.retries = e.retries
         s.wait_steps = 0
         s.deadline = e.deadline
+        s.slo_deadline = e.slo_deadline
         s.admit_seq = self._admit_seq
+        # drafting state seeds from the full replay context (prompt +
+        # prior); emitted tokens extend it as they land
+        s.draft = (drafting_mod.NgramDraftState(prompt)
+                   if self.spec else None)
         self._admit_seq += 1
         tr = self.traces.get(e.rid)
         if tr is not None:
@@ -705,28 +814,34 @@ class ServeEngine:
             self._event("hit", slot, e.rid)
 
     def _admit_ready(self) -> tuple[int, int | None]:
-        """FIFO admission: move queued requests into free slots while
-        both a slot and enough cache blocks are available.  Head-of-line
-        blocking on BLOCK pressure is deliberate — FIFO keeps
-        per-request latency fair (and feeds the preemption trigger);
-        entries serving a retry backoff are skipped past.  With the
-        prefix cache on, each candidate first longest-prefix-matches
-        (``serve.cache`` faults quarantine to that request alone —
-        shared blocks are untouched) and zero-ref cached blocks are
-        evicted LRU-leaf-first to cover any shortfall before the head
-        counts as starved.  Returns ``(admitted, starved_need)`` — the
-        NEW block count the stalled head needs (its cache hit already
-        discounted), or None when nothing block-starved."""
+        """Policy-ordered admission: move queued requests into free
+        slots while both a slot and enough cache blocks are available.
+        ``self.policy.admission_order`` decides the order candidates
+        are considered (FIFO by default), and head-of-line blocking on
+        BLOCK pressure applies to the first block-starved candidate in
+        that order — which is what feeds the preemption trigger, so the
+        policy decides who waits under pressure.  Note the order is a
+        liveness/fairness lever ONLY: per-request output determinism is
+        pinned by the policy-interface contract — row independence plus
+        greedy determinism (scheduler invariant 2) make every request's
+        tokens bit-identical to its solo run under ANY admission order
+        or victim choice, so a policy can never change what anyone's
+        output is, only when it arrives.  Entries serving a retry
+        backoff are skipped past.  With the prefix cache on, each
+        candidate first longest-prefix-matches (``serve.cache`` faults
+        quarantine to that request alone — shared blocks are untouched)
+        and zero-ref cached blocks are evicted LRU-leaf-first to cover
+        any shortfall before the head counts as starved.  Returns
+        ``(admitted, starved_need)`` — the NEW block count the stalled
+        head needs (its cache hit already discounted), or None when
+        nothing block-starved."""
         admitted = 0
-        i = 0
-        while i < len(self._queue):
+        for e in self.policy.admission_order(self._queue):
             free = [j for j, s in enumerate(self._slots)
                     if s.state == FREE]
             if not free:
                 return admitted, None
-            e = self._queue[i]
             if e.wait_steps > 0:          # admit-retry backoff
-                i += 1
                 continue
             need = self._need_blocks(e.req)
             hit: list[int] = []
@@ -746,14 +861,13 @@ class ServeEngine:
                     # retries or fails
                     if (isinstance(exc, faults_mod.PermanentFault)
                             or e.retries >= self.max_retries):
-                        self._queue.pop(i)
+                        self._queue.remove(e)
                         self._finish_queued(e, FAILED, exc)
                     else:
                         e.retries += 1
                         e.wait_steps = 2 ** e.retries
                         self._bump_counter("retries")
                         self._event("retry", -1, e.rid)
-                        i += 1
                     continue
                 short = (need - len(hit)) - self.pool.free_count()
                 if short > 0:             # cache evicts before rows do
@@ -770,16 +884,15 @@ class ServeEngine:
                     self.prefix.release(reversed(hit))
                 if (isinstance(exc, faults_mod.PermanentFault)
                         or e.retries >= self.max_retries):
-                    self._queue.pop(i)
+                    self._queue.remove(e)
                     self._finish_queued(e, FAILED, exc)
                 else:
                     e.retries += 1
                     e.wait_steps = 2 ** e.retries
                     self._bump_counter("retries")
                     self._event("retry", -1, e.rid)
-                    i += 1
                 continue
-            self._queue.pop(i)
+            self._queue.remove(e)
             self._admit_entry(e, free[0], hit)
             admitted += 1
         return admitted, None
@@ -819,7 +932,8 @@ class ServeEngine:
             prior=list(s.prior) + list(s.out),
             retries=s.retries + (1 if retried else 0),
             wait_steps=2 ** (s.retries + 1) if retried else 0,
-            deadline=s.deadline)
+            deadline=s.deadline,
+            slo_deadline=s.slo_deadline)
         self._release_row_blocks(s, register=True)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
@@ -829,12 +943,15 @@ class ServeEngine:
 
     def _preempt(self, need: int) -> int:
         """Free blocks for a starved head: evict zero-ref cached blocks
-        first (they hold no live work), then preempt youngest decoding
-        rows until ``need`` blocks are free (or no candidate remains).
-        Preempted requests re-queue for replay; greedy determinism
-        makes their resumed output bit-identical.  A preempted row's
-        blocks release-to-cache, so the loop re-evicts them on the next
-        pass — preemption still converges on a cache-on engine."""
+        first (they hold no live work), then preempt the policy's
+        victims — FIFO evicts youngest, EDF the slack-richest (largest
+        time-to-SLO-deadline, i.e. least-regretted), priority the
+        lowest-priority — until ``need`` blocks are free (or no
+        candidate remains).  Preempted requests re-queue for replay;
+        greedy determinism makes their resumed output bit-identical
+        whoever is chosen.  A preempted row's blocks release-to-cache,
+        so the loop re-evicts them on the next pass — preemption still
+        converges on a cache-on engine."""
         preempted = 0
         while len(self._free_blocks) < need:
             if self.prefix is not None:
@@ -843,11 +960,11 @@ class ServeEngine:
                 if evicted:
                     self.prefix_counters["evictions"] += evicted
                     continue
-            cands = [(s.admit_seq, i) for i, s in enumerate(self._slots)
+            cands = [(i, s) for i, s in enumerate(self._slots)
                      if s.state == DECODE and self._replayable(s)]
             if not cands:
                 break
-            slot = max(cands)[1]
+            slot = self.policy.victim(cands)
             self._event("preempt", slot, self._slots[slot].request_id)
             self._bump_counter("preemptions")
             self._requeue(slot, retried=False)
@@ -901,6 +1018,12 @@ class ServeEngine:
         log's replay invariant is pinned against ``self.counters``)."""
         self.counters[key] += 1
         self.metrics.counter("serve." + key).inc()
+
+    def _bump_spec(self, key: str, n: int = 1) -> None:
+        """Advance a speculation counter in ``self.spec_counters`` AND
+        its registry mirror (the ``SPEC`` timeline series keys)."""
+        self.spec_counters[key] += n
+        self.metrics.counter("serve.spec." + key).inc(n)
 
     def _finalize_trace(self, rid: int, res: RequestResult) -> None:
         """Terminal bookkeeping for a request's :class:`Trace`: stamp the
@@ -1152,19 +1275,54 @@ class ServeEngine:
             prof.mark("admit")
         decoding = [i for i, s in enumerate(self._slots)
                     if s.state == DECODE]
+        spec = self.spec and bool(decoding)
+        drafts_host: np.ndarray | None = None
+        if spec:
+            # draft phase: each decoding row proposes up to draft_k
+            # continuation tokens from its own history; -1 pads can
+            # never be accepted (argmax preds are >= 0).  Drafting is
+            # an optimization, so a faulting drafter (serve.draft)
+            # degrades its row to plain decode for the round — the
+            # request never fails or retries over a draft.
+            drafts_host = np.full((self.n_slots, self.draft_k), -1,
+                                  np.int32)
+            for slot in decoding:
+                s = self._slots[slot]
+                try:
+                    self.faults.check("serve.draft", key=s.request_id)
+                    prop = (s.draft.propose(self.draft_k)
+                            if s.draft is not None else [])
+                except Exception:
+                    self.metrics.counter("serve.spec.draft_faults").inc()
+                    prop = []
+                if prop:
+                    drafts_host[slot, :len(prop)] = prop
+                    self._bump_spec("proposed", len(prop))
+            if prof is not None:
+                prof.mark("draft")
         if decoding:
             try:
                 active = np.zeros((self.n_slots,), np.int32)
                 active[decoding] = 1
-                tok, self.last_logits, self.pcache = self._tick(
-                    self.params, self.pcache, self.last_logits,
-                    jnp.asarray(active))
+                accept_host = None
+                if spec:
+                    tok, accept, self.last_logits, self.pcache = \
+                        self._spec_tick(
+                            self.params, self.pcache, self.last_logits,
+                            jnp.asarray(drafts_host),
+                            jnp.asarray(active))
+                else:
+                    tok, self.last_logits, self.pcache = self._tick(
+                        self.params, self.pcache, self.last_logits,
+                        jnp.asarray(active))
                 if prof is not None:
                     prof.mark("decode_dispatch")
                 # np.asarray on the device token array is the readback
                 # boundary: everything the tick queued must complete
                 # first, so this wait is the device-time share.
                 tok_host = np.asarray(tok)
+                if spec:
+                    accept_host = np.asarray(accept)
                 if prof is not None:
                     prof.mark("device_sync")
             except Exception as exc:
@@ -1175,14 +1333,25 @@ class ServeEngine:
                 progress += len(decoding)
             else:
                 progress += len(decoding)
+                if spec:
+                    self._bump_spec("rounds")
                 for slot in decoding:
                     s = self._slots[slot]
-                    t = int(tok_host[slot])
+                    emit = [int(tok_host[slot])]
+                    if accept_host is not None:
+                        acc = int(accept_host[slot])
+                        emit += [int(x) for x in
+                                 drafts_host[slot, :acc]]
+                        self._bump_spec("row_rounds")
+                        self._bump_spec("accepted", acc)
+                        self.metrics.histogram(
+                            "serve.spec.accepted_per_round").observe(acc)
                     try:
                         self.faults.check("serve.tick", key=s.request_id)
-                        if not 0 <= t < self.cfg.vocab_size:
-                            raise faults_mod.PermanentFault(
-                                "serve.tick", s.request_id, -1)
+                        for t in emit:
+                            if not 0 <= t < self.cfg.vocab_size:
+                                raise faults_mod.PermanentFault(
+                                    "serve.tick", s.request_id, -1)
                     except Exception as exc:
                         self._row_fault(slot, exc)
                         continue
@@ -1192,12 +1361,22 @@ class ServeEngine:
                             tr.first_token_ts = time.monotonic()
                             self.metrics.histogram(
                                 "serve.ttft_s").observe(tr.ttft_s)
-                    s.out.append(t)
-                    s.budget -= 1
-                    if s.budget <= 0 or t == s.eos:
-                        self._terminate(slot, OK)
+                    # accepted drafts emit in order behind the
+                    # unconditional token; a terminal token (budget or
+                    # eos) discards the rest of the round — the row's
+                    # over-advanced device length dies with the slot
+                    for t in emit:
+                        s.out.append(t)
+                        s.budget -= 1
+                        if s.draft is not None:
+                            s.draft.extend((t,))
+                        if s.budget <= 0 or t == s.eos:
+                            self._terminate(slot, OK)
+                            break
         if prof is not None:
-            prof.mark("sample_postprocess")
+            # spec engines account their acceptance/emission loop as
+            # `verify`; plain engines keep the classic name
+            prof.mark("verify" if spec else "sample_postprocess")
         if self.timeline is not None:
             self.timeline.counter(
                 "serving.scheduler", "SCHED",
@@ -1208,6 +1387,10 @@ class ServeEngine:
                  "free_blocks": len(self._free_blocks)})
             self.timeline.counter(
                 "serving.scheduler", "LIFECYCLE", dict(self.counters))
+            if self.spec:
+                self.timeline.counter(
+                    "serving.scheduler", "SPEC",
+                    dict(self.spec_counters))
             if self.prefix is not None:
                 self.timeline.counter(
                     "serving.scheduler", "PREFIX",
@@ -1533,6 +1716,79 @@ def measure_prefix_throughput(
         "serve_prefix_speedup": timings[False] / timings[True],
         "serve_prefix_hit_rate": hit_rate,
         "serve_prefix_tokens_skipped": tokens_skipped,
+        "tokens": n_tokens,
+        "n_requests": len(requests),
+        "n_slots": n_slots,
+        "max_len": max_len,
+        "chunk": chunk,
+    }
+
+
+def measure_spec_throughput(
+    params: dict, cfg: llama.LlamaConfig, requests: list[Request], *,
+    n_slots: int, max_len: int, chunk: int,
+    block_size: int | None = None, n_blocks: int | None = None,
+    draft_k: int = 4,
+) -> dict:
+    """Speculation-on vs plain-decode throughput on one workload (the
+    ``serve_spec_*`` bench metrics).
+
+    Both engines serve the same queue; each is warmed by a full untimed
+    pass (compiles every program — the spec engine's always-wide
+    ``spec_tick`` included), then timed on a second pass.  Outputs are
+    asserted token-identical between the two engines — the greedy
+    bit-identity guarantee of :func:`llama.spec_verify_paged
+    <horovod_tpu.models.llama.spec_verify_paged>` — so the ratio prices
+    pure scheduling, never output drift.  Returns
+    ``serve_spec_tokens_per_sec`` (spec on),
+    ``serve_spec_plain_tokens_per_sec``, ``serve_spec_vs_plain_ratio``,
+    ``serve_spec_accepted_per_round`` (mean accepted drafts per
+    decoding row per verify round, timed pass),
+    ``serve_spec_rounds`` (timed-pass verify ticks), ``draft_k`` and
+    workload shape fields.  The ratio beats 1 exactly when acceptance
+    buys more rounds than the wider tick costs — lookup-friendly
+    (repetitive) workloads win, lookup-hostile (random) ones price the
+    overhead floor.
+    """
+    if not requests:
+        raise ValueError("empty workload")
+    kw = dict(n_slots=n_slots, max_len=max_len, chunk=chunk,
+              block_size=block_size, n_blocks=n_blocks,
+              metrics=metrics_mod.NULL)
+    timings: dict[bool, float] = {}
+    outputs: dict[bool, list[RequestResult]] = {}
+    n_tokens = 0
+    accepted_per_round = 0.0
+    rounds = 0
+    for spec_on in (False, True):
+        eng = ServeEngine(params, cfg, spec=spec_on, draft_k=draft_k,
+                          **kw)
+        warm = eng.run(requests)
+        assert all(r.ok for r in warm), [r.status for r in warm]
+        n_tokens = sum(len(t) for t in warm)
+        acc0 = eng.spec_counters["accepted"]
+        rr0 = eng.spec_counters["row_rounds"]
+        rounds0 = eng.spec_counters["rounds"]
+        t0 = time.perf_counter()
+        out = eng.run(requests)
+        jax.block_until_ready(eng.pcache.k)
+        timings[spec_on] = time.perf_counter() - t0
+        outputs[spec_on] = out
+        if spec_on:
+            rr = eng.spec_counters["row_rounds"] - rr0
+            accepted_per_round = (
+                (eng.spec_counters["accepted"] - acc0) / rr if rr
+                else 0.0)
+            rounds = eng.spec_counters["rounds"] - rounds0
+    assert [list(a) for a in outputs[True]] == \
+        [list(b) for b in outputs[False]], "speculation parity broken"
+    return {
+        "serve_spec_tokens_per_sec": n_tokens / timings[True],
+        "serve_spec_plain_tokens_per_sec": n_tokens / timings[False],
+        "serve_spec_vs_plain_ratio": timings[False] / timings[True],
+        "serve_spec_accepted_per_round": accepted_per_round,
+        "serve_spec_rounds": rounds,
+        "draft_k": draft_k,
         "tokens": n_tokens,
         "n_requests": len(requests),
         "n_slots": n_slots,

@@ -154,9 +154,18 @@ def test_engine_pipelined_dispatch_native_controller(monkeypatch):
 
 @pytest.mark.faults
 @pytest.mark.metrics
-@pytest.mark.parametrize("prefix_cache", [False, True])
-@pytest.mark.parametrize("seed", [3, 17])
-def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, tmp_path):
+@pytest.mark.spec
+# The spec axis rides two of the four seed combos (cache off on one
+# seed, cache on on the other) rather than the full cross-product —
+# the spec engine's per-combo cost is a whole extra jit program, and
+# the directed spec tests in test_spec_sched.py carry the rest.
+@pytest.mark.parametrize("seed,prefix_cache,spec", [
+    (3, False, False), (3, True, False),
+    (17, False, False), (17, True, False),
+    (3, False, True), (17, True, True),
+])
+def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, spec,
+                                          tmp_path):
     """Randomized request lifecycle sweep of the ServeEngine under an
     overcommitted KV pool: seeded random prompts/budgets, one hard
     deadline, one permanently poisoned request, transient injected
@@ -168,7 +177,10 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, tmp_path):
     and the non-OK statuses land exactly where the schedule says.
     Runs with the shared-prefix cache both off (classic free-list
     accounting) and on (release-to-cache: the same sweep must drain to
-    a consistent radix index with zero live references).
+    a consistent radix index with zero live references), and with
+    self-drafting speculation both off and on — preempt-replay,
+    cancels, and faults under a multi-token-per-tick emission stream
+    must still land every OK request bit-identical to its solo run.
 
     The observability layer rides the same sweep: the registry's
     lifecycle counters must grow monotonically step over step, every
@@ -209,11 +221,12 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, tmp_path):
     # Overcommitted pool: full backing would be 2*6+1 = 13 blocks; 9
     # forces admission stalls and preemption-with-replay churn.
     reg = FaultRegistry()
-    log_path = str(tmp_path / f"events_{seed}_{prefix_cache}.jsonl")
+    log_path = str(tmp_path / f"events_{seed}_{prefix_cache}_{spec}.jsonl")
     mreg = MetricsRegistry(event_log=EventLog(log_path))
     eng = ServeEngine(params, cfg, n_slots=2, max_len=max_len, chunk=4,
                       block_size=4, n_blocks=9, preempt_after=2,
-                      faults=reg, prefix_cache=prefix_cache, metrics=mreg)
+                      faults=reg, prefix_cache=prefix_cache, metrics=mreg,
+                      spec=spec, draft_k=3)
     ids = [eng.submit(r) for r in reqs]
     reg.inject("serve.tick", on_hit=2, permanent=True, key=ids[perm])
     reg.inject("serve.admit", on_hit=1, key=ids[tr_admit])
@@ -279,10 +292,15 @@ def test_serve_engine_fault_schedule_fuzz(seed, prefix_cache, tmp_path):
                 got, want[:len(got)].astype(np.int64),
                 err_msg=f"seed={seed} rid={ids[i]} partial diverged")
     assert statuses[dl] == TIMEOUT and statuses[perm] == FAILED
-    # Lifecycle churn must not leak device state: the three compiled
-    # programs and the whole block pool survive the sweep intact.
-    assert eng.compile_cache_sizes() == {"tick": 1, "chunk": 1,
-                                         "set_row": 1}
+    # Lifecycle churn must not leak device state: the compiled programs
+    # (spec engines swap the 1-wide tick for the K+1-wide verify tick)
+    # and the whole block pool survive the sweep intact.
+    if spec:
+        assert eng.compile_cache_sizes() == {"tick": 0, "chunk": 1,
+                                             "set_row": 1, "spec_tick": 1}
+    else:
+        assert eng.compile_cache_sizes() == {"tick": 1, "chunk": 1,
+                                             "set_row": 1}
     if prefix_cache:
         # drained: no live references; every block is either free or
         # parked zero-ref in a structurally sound radix index

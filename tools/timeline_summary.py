@@ -18,12 +18,14 @@ and ``--json`` modes aggregate across the ranks, with tensors prefixed
 Prints per-tensor negotiation and execution durations, per-phase totals,
 the negotiation tick counts per rank (NEGOTIATE_TICK_r<k> instants —
 reference timeline.cc:98-132 parity), aggregated counter (``ph: "C"``)
-series — the serving scheduler's SCHED/LIFECYCLE/PREFIX tracks: final
-values plus the delta and sample count across the trace — and
+series — the serving scheduler's SCHED/LIFECYCLE/PREFIX tracks, plus
+SPEC (speculative-decode rounds/proposed/accepted, spec engines only):
+final values plus the delta and sample count across the trace — and
 per-request async spans (the engine's ``REQ`` ``b``/``e`` pairs, one id
 per request).  The serving profiler's ``phase/<name>`` spans (one id per
 tick, ``HVD_TPU_PROFILE=1``) get their own per-phase table with each
-top-level phase's share of the tiled tick time.  ``--json`` dumps the
+top-level phase's share of the tiled tick time (``draft``/``verify``
+appear there on spec engines).  ``--json`` dumps the
 whole summary dict as JSON for scripting.
 """
 
