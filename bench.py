@@ -733,6 +733,60 @@ def _bench_serve_spec(hvd, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_serve_router(hvd, on_tpu: bool) -> dict:
+    """Multi-replica router arm (extras, TPU only): a shared-prefix
+    workload served through the RouterServer over an in-process fleet,
+    ``prefix_affinity`` vs ``round_robin``.  Affinity concentrates each
+    prompt family on one replica so its radix cache stays hot; round
+    robin smears families across the fleet and pays one cold prefill
+    per replica per family.  The dashboard sees the fleet prefix hit
+    rate and tokens/sec per policy (acceptance bar:
+    ``serve_router_hit_rate_gain > 0`` — affinity strictly beats round
+    robin).  Output parity across policies is asserted inside the
+    helper: routing must never change tokens."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.router import measure_router_fleet
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        # n_groups coprime to n_replicas: with G == R round robin
+        # accidentally aligns each family to one replica and the
+        # contrast vanishes.
+        kw = dict(n_replicas=3, n_groups=4, waves=4, prefix_blocks=2,
+                  suffix_len=2, max_new_tokens=4, n_slots=4, chunk=4)
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        kw = dict(n_replicas=3, n_groups=4, waves=8, prefix_blocks=3,
+                  suffix_len=32, max_new_tokens=32, n_slots=8, chunk=64)
+    params = llama.init_params(cfg, jax.random.key(0))
+    r = measure_router_fleet(params, cfg, **kw)
+    return {
+        "serve_router_hit_rate_affinity": round(
+            r["serve_router_hit_rate_prefix_affinity"], 3),
+        "serve_router_hit_rate_round_robin": round(
+            r["serve_router_hit_rate_round_robin"], 3),
+        "serve_router_hit_rate_gain": round(
+            r["serve_router_hit_rate_gain"], 3),
+        "serve_router_tokens_per_sec_affinity": round(
+            r["serve_router_tokens_per_sec_prefix_affinity"], 1),
+        "serve_router_tokens_per_sec_round_robin": round(
+            r["serve_router_tokens_per_sec_round_robin"], 1),
+        "serve_router_shape": (
+            f"r{kw['n_replicas']}_g{kw['n_groups']}_w{kw['waves']}_"
+            f"s{kw['n_slots']}_chunk{kw['chunk']}"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1237,7 +1291,8 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # newer arms.
     for fn in (_bench_fusion, _bench_serving,
                _bench_serving_overcommit, _bench_serve_prefix,
-               _bench_serve_spec, _bench_resnet101_big_batch,
+               _bench_serve_spec, _bench_serve_router,
+               _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):
         if time.monotonic() - _T_START > budget_s:

@@ -26,6 +26,12 @@ Named sites currently wired:
                    a firing drafter degrades that row to plain decode
                    for the round; drafting is an optimization, so the
                    request itself never fails or retries
+``serve.router``   per replica pump iteration in the
+                   :class:`~horovod_tpu.router.RouterServer` fleet
+                   (key = replica name) — a firing rule kills that
+                   replica; the router re-enqueues its in-flight
+                   requests to survivors (replay keeps outputs
+                   bit-identical)
 ``data.producer``  per batch assembled by the
                    :class:`~horovod_tpu.data.ShardedLoader` prefetch
                    thread (key = batch index)

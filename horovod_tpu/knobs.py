@@ -84,6 +84,18 @@ ENV_KNOBS = (
      "Ticks in the profiler's rolling per-phase report window."),
     ("HVD_TPU_RETRACE_FATAL", "0",
      "Raise when the retrace sentry sees a jit cache grow mid-serve."),
+    ("HVD_TPU_ROUTER_IMBALANCE", "4",
+     "Inflight gap above which prefix_affinity falls back to least_loaded."),
+    ("HVD_TPU_ROUTER_MIN_FREE_KV", "0",
+     "Fleet free-KV fraction floor below which the router sheds (0 = off)."),
+    ("HVD_TPU_ROUTER_MIN_GOODPUT", "0",
+     "Fleet goodput floor below which the router sheds load (0 = off)."),
+    ("HVD_TPU_ROUTER_POLICY", "prefix_affinity",
+     "RouterServer policy: round_robin, least_loaded, or prefix_affinity."),
+    ("HVD_TPU_ROUTER_POLL_S", "0.05",
+     "Seconds between router polls of replica health and snapshots."),
+    ("HVD_TPU_ROUTER_PORT", "",
+     "Port for the RouterServer HTTP front door (maybe_start_router)."),
     ("HVD_TPU_SCHED_POLICY", "fifo",
      "ServeEngine scheduler policy: fifo, priority, or edf."),
     ("HVD_TPU_SLO_E2E_S", "0",

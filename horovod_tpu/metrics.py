@@ -88,6 +88,7 @@ FAULT_SITES: tuple[str, ...] = (
     "serve.tick",
     "serve.cache",
     "serve.draft",
+    "serve.router",
     "data.producer",
 )
 
@@ -186,6 +187,19 @@ METRIC_HELP: dict[str, str] = {
     # monitor.* — the cross-rank observability layer itself
     "monitor.scrapes": "HTTP requests served by the /metrics exporter",
     "monitor.aggregations": "Cross-rank aggregate_snapshots() rounds completed",
+    # router.* — the multi-replica front door (horovod_tpu.router)
+    "router.requests": "Requests received at the router front door",
+    "router.routed.round_robin": "Requests placed by the round_robin policy",
+    "router.routed.least_loaded": "Requests placed by the least_loaded policy",
+    "router.routed.prefix_affinity": "Requests placed by the prefix_affinity policy",
+    "router.affinity_hit_tokens": "Tokens of shadow-index prefix shared with the chosen replica",
+    "router.affinity_fallbacks": "Prefix-affinity choices overridden by the load-imbalance fallback",
+    "router.sheds": "Requests REJECTED by router admission control (goodput / free-KV floors)",
+    "router.failovers": "In-flight requests re-enqueued to survivors after a replica loss",
+    "router.replica_deaths": "Replica healthy-to-dead transitions observed by the router",
+    "router.replicas_healthy": "Replicas currently accepting routed requests",
+    "router.inflight": "Routed requests not yet terminal, fleet-wide",
+    "router.shadow_index_bytes": "Approximate host bytes of the per-replica shadow prefix indexes",
 }
 
 

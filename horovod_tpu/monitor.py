@@ -60,7 +60,7 @@ from typing import Any, Iterable
 from horovod_tpu import metrics as metrics_mod
 
 
-def _env_float(name: str, default: float) -> float:
+def env_float(name: str, default: float) -> float:
     """Tolerant float env parsing (the ``_negotiate_timeout_s`` idiom):
     an unparsable value warns and falls back instead of crashing a job
     at import time."""
@@ -374,7 +374,7 @@ class StragglerDetector:
         self.registry = (registry if registry is not None
                          else metrics_mod.DEFAULT)
         self.warn_s = (warn_s if warn_s is not None
-                       else _env_float("HVD_TPU_STRAGGLER_WARN_S", 1.0))
+                       else env_float("HVD_TPU_STRAGGLER_WARN_S", 1.0))
         self._lock = threading.Lock()
         self._steps: collections.deque[float] = collections.deque(
             maxlen=window)
@@ -495,7 +495,7 @@ class SLOWindow:
         if window <= 0:
             raise ValueError(f"window must be positive, got {window}")
         self.slo_e2e_s = (slo_e2e_s if slo_e2e_s is not None
-                          else (_env_float("HVD_TPU_SLO_E2E_S", 0.0) or None))
+                          else (env_float("HVD_TPU_SLO_E2E_S", 0.0) or None))
         self._lock = threading.Lock()
         self._traces: collections.deque = collections.deque(maxlen=window)
 

@@ -51,12 +51,47 @@ is pinned by ``tests/test_prefix_cache.py`` against cache-off runs.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import sys
 from typing import Iterable
 
 from horovod_tpu import metrics as metrics_mod
 from horovod_tpu.models.llama import BlockPool
+
+
+def _update_chunk(h: "hashlib._Hash", chunk: Iterable[int]) -> None:
+    """Fold one block-size token chunk into a running path digest.
+    Token ids render as decimal bytes with unambiguous separators, so
+    the encoding is stable across processes and Python versions (unlike
+    the salted builtin ``hash``)."""
+    h.update(b"|")
+    for t in chunk:
+        h.update(str(int(t)).encode())
+        h.update(b",")
+
+
+def chunk_path_digests(tokens: Iterable[int], block_size: int,
+                       max_chunks: int | None = None) -> list[str]:
+    """Digest every block-aligned prefix of ``tokens``.
+
+    Entry ``i`` digests ``tokens[:(i + 1) * block_size]`` — exactly the
+    token path a depth-``i + 1`` radix node spells — so membership of a
+    prompt's digests in a cache's :meth:`RadixPrefixCache.key_digest`
+    summary measures the longest indexed prefix WITHOUT shipping the
+    tokens themselves.  Incremental blake2b keeps the whole list one
+    pass over the prompt."""
+    tokens = list(tokens)
+    h = hashlib.blake2b(digest_size=8)
+    n = len(tokens) // block_size
+    if max_chunks is not None:
+        n = min(n, max_chunks)
+    out: list[str] = []
+    for i in range(n):
+        _update_chunk(h, tokens[i * block_size:(i + 1) * block_size])
+        out.append(h.hexdigest())
+    return out
 
 
 @dataclasses.dataclass
@@ -126,6 +161,40 @@ class RadixPrefixCache:
             total += (sys.getsizeof(node) + sys.getsizeof(node.key)
                       + sys.getsizeof(node.children))
         return total
+
+    def key_digest(self, max_paths: int = 256) -> dict:
+        """Bounded summary of the index for cache-aware routing.
+
+        Returns ``{"block_size", "indexed_blocks", "n_paths",
+        "truncated", "paths"}`` where ``paths`` holds up to
+        ``max_paths`` hex digests of root-to-node token paths
+        (:func:`chunk_path_digests` encoding), breadth-first — shallow
+        prefixes (the system prompts a router cares about) always make
+        the cut; deep divergent tails are what truncation drops.  A
+        router matches a prompt by digesting its own chunks and finding
+        the deepest digest present here; no token ever leaves the
+        replica.  Cost is one ``blake2b.copy()`` + one chunk hash per
+        emitted path, so the summary is cheap enough to ride every
+        ``metrics_snapshot()``."""
+        paths: list[str] = []
+        base = hashlib.blake2b(digest_size=8)
+        q: "collections.deque[tuple[RadixNode, hashlib._Hash]]" = \
+            collections.deque(
+                (child, base) for child in self._root.children.values())
+        while q and len(paths) < max_paths:
+            node, parent_h = q.popleft()
+            h = parent_h.copy()
+            _update_chunk(h, node.key)
+            paths.append(h.hexdigest())
+            for c in node.children.values():
+                q.append((c, h))
+        return {
+            "block_size": self.block_size,
+            "indexed_blocks": len(self._nodes),
+            "n_paths": len(paths),
+            "truncated": len(self._nodes) > len(paths),
+            "paths": paths,
+        }
 
     def __contains__(self, block: int) -> bool:
         return block in self._nodes

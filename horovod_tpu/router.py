@@ -1,0 +1,1150 @@
+"""Multi-replica serving front door: prefix-locality routing, admission
+control, and goodput-driven load shedding over N ``ServeEngine``\\ s.
+
+PRs 1-8 finished the single-rank serving core — engine, faults, prefix
+cache, observability, profiler, speculation.  This module is ROADMAP
+open item 2, the scale-out layer: **many engines, one door**.  A
+stdlib-only HTTP server (:class:`RouterServer`, the ``monitor.py``
+threading-HTTP pattern) fronts a fleet of replica backends and decides,
+per request, *which* replica serves it:
+
+* **Pluggable routing** via :class:`RoutingPolicy` — the same seam
+  shape as PR 8's :class:`~horovod_tpu.scheduling.SchedulerPolicy`:
+  policies see read-only fleet state and return a choice, never mutate
+  scheduler internals, never touch device programs.
+  :class:`RoundRobinPolicy` cycles, :class:`LeastLoadedPolicy` picks
+  the emptiest replica (fewest in-flight, best goodput), and the
+  headline :class:`PrefixAffinityPolicy` routes SGLang-style by
+  **cache locality**: the router keeps a :class:`ShadowPrefixIndex`
+  per replica — a bounded set of radix *path digests*, fed both by its
+  own routing decisions and by each replica's
+  :meth:`~horovod_tpu.prefix_cache.RadixPrefixCache.key_digest`
+  summary off ``/snapshot`` — and sends each request to the replica
+  sharing the longest cached prefix, falling back to least-loaded past
+  a load-imbalance threshold (``HVD_TPU_ROUTER_IMBALANCE``).  No token
+  ever leaves a replica: digests are stable blake2b chunk hashes
+  (:func:`~horovod_tpu.prefix_cache.chunk_path_digests`).
+
+* **Admission control on the observability plane.**  A poller thread
+  probes each replica (in-process :class:`LocalReplica` view, or HTTP
+  ``/snapshot`` + ``/healthz`` for :class:`HttpReplica`); when fleet
+  goodput or the free-KV fraction drops below the
+  ``HVD_TPU_ROUTER_MIN_GOODPUT`` / ``HVD_TPU_ROUTER_MIN_FREE_KV``
+  floors the router sheds new work with ``REJECTED`` — the *same*
+  terminal status contract as the engine's own queue-overflow shed and
+  (since this PR) its malformed-request rejection, so a client checks
+  one field no matter which layer said no.
+
+* **Failover by replay.**  A replica death (the ``serve.router``
+  fault site in the :class:`LocalReplica` pump, a 503/connection error
+  for HTTP replicas) marks it dead and re-enqueues its in-flight
+  requests to survivors from the full original prompt.  Greedy decode
+  is deterministic (scheduler invariant 2, PR 2), so the failed-over
+  output is **bit-identical** to an uninterrupted run — mid-stream
+  replica loss is invisible in the tokens, visible only in
+  ``router.failovers``.
+
+Everything is host-side bookkeeping: the router never allocates device
+memory, never adds a jit signature, and works against replicas it can
+only see through HTTP.  ``router.*`` metrics land in the router's own
+registry (scraped at ``GET /metrics``); per-replica detail that
+Prometheus names can't carry (the registry has no labels) is JSON at
+``GET /replicas``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Sequence
+
+from horovod_tpu import faults as faults_mod
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.monitor import env_float
+from horovod_tpu.prefix_cache import chunk_path_digests
+from horovod_tpu.serving import (FAILED, OK, REJECTED, Request,
+                                 RequestResult)
+
+# ---------------------------------------------------------------------------
+# Shadow prefix index: what the router believes each replica has cached.
+# ---------------------------------------------------------------------------
+
+
+class ShadowPrefixIndex:
+    """A bounded, token-free mirror of one replica's radix index.
+
+    Holds hex digests of root-to-node chunk paths
+    (:func:`~horovod_tpu.prefix_cache.chunk_path_digests` encoding).
+    Two feeds keep it warm: :meth:`observe` digests every prompt the
+    router sends to the replica (optimistic — the replica will cache it
+    on retirement), and :meth:`load` merges the replica's own
+    ``key_digest()`` summary from ``/snapshot`` (authoritative for what
+    actually survived admission and eviction).  Matching walks a
+    prompt's digests shallow-to-deep and stops at the first absent one,
+    so a match is always a *contiguous* cached prefix — exactly what
+    the engine's longest-prefix admission can reuse.
+
+    The index is bounded FIFO at ``max_paths`` digests; staleness is
+    benign in both directions (a phantom path costs one suboptimal
+    route, a missing one costs one missed affinity hit).  Instances are
+    mutated only under the owning router's lock — no lock of their own.
+    """
+
+    def __init__(self, block_size: int = 0, max_paths: int = 4096):
+        self.block_size = block_size
+        self.max_paths = max_paths
+        self._digests: set[str] = set()
+        self._order: collections.deque[str] = collections.deque()
+
+    def _add(self, digest: str) -> None:
+        if digest in self._digests:
+            return
+        self._digests.add(digest)
+        self._order.append(digest)
+        while len(self._order) > self.max_paths:
+            self._digests.discard(self._order.popleft())
+
+    def observe(self, tokens: Sequence[int]) -> None:
+        """Optimistically index a prompt the router just routed here."""
+        if self.block_size < 1:
+            return
+        for d in chunk_path_digests(tokens, self.block_size):
+            self._add(d)
+
+    def load(self, summary: dict | None) -> None:
+        """Merge a replica ``key_digest()`` summary (adopts its
+        ``block_size`` when the shadow doesn't know one yet)."""
+        if not summary:
+            return
+        bs = summary.get("block_size", 0)
+        if self.block_size < 1 and bs >= 1:
+            self.block_size = bs
+        for d in summary.get("paths", ()):
+            self._add(d)
+
+    def match_tokens(self, tokens: Sequence[int]) -> int:
+        """Tokens of the longest contiguous cached prefix of
+        ``tokens`` this shadow knows about (0 without a block size)."""
+        if self.block_size < 1:
+            return 0
+        depth = 0
+        for d in chunk_path_digests(tokens, self.block_size):
+            if d not in self._digests:
+                break
+            depth += 1
+        return depth * self.block_size
+
+    def __len__(self) -> int:
+        return len(self._digests)
+
+    def approx_footprint_bytes(self) -> int:
+        """Shallow host-bytes estimate (the same leak-trend-line role
+        as the radix index's ``approx_footprint_bytes``)."""
+        total = sys.getsizeof(self._digests) + sys.getsizeof(self._order)
+        for d in self._digests:
+            total += 2 * sys.getsizeof(d)       # set entry + deque entry
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Routing policies (the SchedulerPolicy seam shape, one layer up).
+# ---------------------------------------------------------------------------
+
+
+class RoutingPolicy:
+    """Per-request replica choice.
+
+    ``choose(candidates, req, ctx)`` picks one name from the non-empty
+    ``candidates`` list (healthy replicas, router order) and returns
+    ``(name, info)`` where ``info`` may carry ``affinity_hit_tokens``
+    and ``fallback`` for the router's metrics.  ``ctx`` is a read-only
+    :class:`RoutingContext`; policies never mutate router state."""
+
+    name = "base"
+
+    def choose(self, candidates: Sequence[str], req: Request,
+               ctx: "RoutingContext") -> tuple[str, dict]:
+        raise NotImplementedError
+
+
+class RoutingContext:
+    """What a policy may look at: per-replica ``views`` (the poller's
+    last probe dicts), ``shadows`` (per-replica
+    :class:`ShadowPrefixIndex`), and ``inflight`` (requests routed but
+    not yet terminal, per replica — live, not poll-delayed)."""
+
+    def __init__(self, views: dict, shadows: dict, inflight: dict,
+                 imbalance: float):
+        self.views = views
+        self.shadows = shadows
+        self.inflight = inflight
+        self.imbalance = imbalance
+
+    def load(self, name: str) -> tuple:
+        """Sort key: emptier and healthier first, stable by name."""
+        v = self.views.get(name, {})
+        return (self.inflight.get(name, 0),
+                -v.get("goodput", 1.0), name)
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    """Cycle the healthy set in order — the baseline every affinity
+    claim is measured against."""
+
+    name = "round_robin"
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def choose(self, candidates: Sequence[str], req: Request,
+               ctx: RoutingContext) -> tuple[str, dict]:
+        name = candidates[self._next % len(candidates)]
+        self._next += 1
+        return name, {}
+
+
+class LeastLoadedPolicy(RoutingPolicy):
+    """Fewest in-flight requests wins; goodput breaks ties (a replica
+    missing its SLOs is effectively fuller than its queue says)."""
+
+    name = "least_loaded"
+
+    def choose(self, candidates: Sequence[str], req: Request,
+               ctx: RoutingContext) -> tuple[str, dict]:
+        return min(candidates, key=ctx.load), {}
+
+
+class PrefixAffinityPolicy(RoutingPolicy):
+    """Longest shared cached prefix wins (RadixAttention locality,
+    router-side): route to the replica whose shadow index matches the
+    most prompt tokens, so the engine's longest-prefix admission skips
+    the most prefill.  Ties — including the no-match cold start — fall
+    to least-loaded.  When the affinity choice is already
+    ``imbalance`` in-flight requests deeper than the emptiest healthy
+    replica, locality loses to load and the router falls back to
+    least-loaded (``info["fallback"]``), keeping one hot prefix from
+    starving the fleet."""
+
+    name = "prefix_affinity"
+
+    def choose(self, candidates: Sequence[str], req: Request,
+               ctx: RoutingContext) -> tuple[str, dict]:
+        matches = {n: ctx.shadows[n].match_tokens(req.prompt)
+                   for n in candidates if n in ctx.shadows}
+        best = max(matches.values(), default=0)
+        if best <= 0:
+            return min(candidates, key=ctx.load), {
+                "affinity_hit_tokens": 0, "fallback": False}
+        pick = min((n for n in candidates if matches.get(n, 0) == best),
+                   key=ctx.load)
+        emptiest = min(candidates, key=ctx.load)
+        gap = (ctx.inflight.get(pick, 0)
+               - ctx.inflight.get(emptiest, 0))
+        if gap > ctx.imbalance:
+            return emptiest, {
+                "affinity_hit_tokens": matches.get(emptiest, 0),
+                "fallback": True}
+        return pick, {"affinity_hit_tokens": best, "fallback": False}
+
+
+ROUTING_POLICIES: dict[str, type[RoutingPolicy]] = {
+    "round_robin": RoundRobinPolicy,
+    "least_loaded": LeastLoadedPolicy,
+    "prefix_affinity": PrefixAffinityPolicy,
+}
+
+
+def resolve_routing_policy(
+    policy: "RoutingPolicy | str | None" = None,
+) -> RoutingPolicy:
+    """An instance passes through; a name constructs; ``None`` reads
+    ``HVD_TPU_ROUTER_POLICY`` (unset/empty → ``prefix_affinity``)."""
+    if isinstance(policy, RoutingPolicy):
+        return policy
+    name = (policy or os.environ.get("HVD_TPU_ROUTER_POLICY", "")
+            or "prefix_affinity")
+    cls = ROUTING_POLICIES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown routing policy {name!r}; choose from "
+            f"{sorted(ROUTING_POLICIES)}")
+    return cls()
+
+
+# ---------------------------------------------------------------------------
+# Replica handles: how the router talks to a backend.
+# ---------------------------------------------------------------------------
+
+
+#: A submission's completion callback.  Called exactly once per
+#: submission with the terminal :class:`RequestResult`, or ``None``
+#: when the replica died first — ``None`` is the router's failover
+#: signal, never a client-visible outcome.
+DoneCallback = Callable[["RequestResult | None"], None]
+
+
+class ReplicaHandle:
+    """One backend the router can route to.  Implementations must make
+    ``submit`` safe from any thread and guarantee the callback fires
+    exactly once (result or ``None``-on-death) for every accepted
+    submission."""
+
+    name = "replica"
+    block_size = 0      # 0 = unknown / no prefix cache
+
+    def submit(self, req: Request, done_cb: DoneCallback) -> None:
+        raise NotImplementedError
+
+    def probe(self) -> dict:
+        """Poller view: ``healthy``, ``inflight``, ``queue_depth``,
+        ``goodput``, ``free_kv_frac``, and optionally ``prefix`` (a
+        ``key_digest()`` summary)."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+
+class LocalReplica(ReplicaHandle):
+    """An in-process :class:`~horovod_tpu.serving_scheduler.ServeEngine`
+    behind the handle interface, driven by one daemon **pump** thread
+    that owns the engine exclusively: submissions from router handler
+    threads land in an inbox; the pump drains it into
+    ``engine.submit`` and calls ``engine.step`` while work is pending,
+    dispatching completion callbacks as requests retire.
+
+    The pump checks the ``serve.router`` fault site (key = replica
+    name) before every engine step; a firing rule — transient or
+    permanent, the site models process loss either way — kills the
+    replica: the pump marks it dead, notifies the router, and fires
+    every in-flight callback with ``None`` so the router re-enqueues
+    those requests on survivors.  Because replay from the full prompt
+    is bit-identical (greedy determinism), the death point never shows
+    in any output."""
+
+    _GUARDED_BY_LOCK = ("_inbox", "_cbs", "_dead", "_view", "_stop")
+
+    def __init__(self, engine: Any, name: str = "local",
+                 faults: "faults_mod.FaultRegistry | None" = None,
+                 on_death: "Callable[[LocalReplica], None] | None" = None):
+        self.engine = engine
+        self.name = name
+        self.block_size = (engine.block_size
+                           if getattr(engine, "prefix", None) is not None
+                           else 0)
+        self.faults = faults if faults is not None \
+            else faults_mod.FaultRegistry()
+        self.on_death = on_death
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._inbox: list[tuple[Request, DoneCallback]] = []
+        self._cbs: dict[int, DoneCallback] = {}
+        self._dead = False
+        self._stop = False
+        self._view: dict = {"healthy": True, "inflight": 0,
+                            "queue_depth": 0, "goodput": 1.0,
+                            "free_kv_frac": 1.0, "prefix": None}
+        self._thread = threading.Thread(
+            target=self._pump, name=f"hvd-replica-{name}", daemon=True)
+        self._thread.start()
+
+    # -- handle interface --------------------------------------------------
+
+    def submit(self, req: Request, done_cb: DoneCallback) -> None:
+        with self._lock:
+            if not self._dead and not self._stop:
+                self._inbox.append((req, done_cb))
+                self._wake.set()
+                return
+        done_cb(None)       # dead on arrival: immediate failover signal
+
+    def probe(self) -> dict:
+        with self._lock:
+            return dict(self._view)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # -- the pump thread ---------------------------------------------------
+
+    def _refresh_view_locked(self) -> None:
+        eng = self.engine
+        total = max(eng.pcache.k.shape[1] - 1, 1)
+        free = eng.free_block_count() + eng.cached_block_count()
+        self._view = {
+            "healthy": not self._dead,
+            "inflight": len(self._cbs),
+            "queue_depth": len(self._cbs),
+            "goodput": eng.slo.goodput(),
+            "free_kv_frac": free / total,
+            "prefix": (eng.prefix.key_digest()
+                       if eng.prefix is not None else None),
+        }
+
+    def _pump(self) -> None:
+        eng = self.engine
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                batch, self._inbox = self._inbox, []
+            for k, (req, cb) in enumerate(batch):
+                try:
+                    rid = eng.submit(req)
+                except ValueError as e:
+                    # Engine-side programming/config validation: surface
+                    # as a terminal REJECTED rather than killing a
+                    # well-behaved fleet over one bad request.
+                    cb(RequestResult([], REJECTED, e))
+                    continue
+                except BaseException:
+                    for _req3, cb3 in batch[k:]:
+                        cb3(None)
+                    self._die()
+                    return
+                if rid in eng.results:      # rejected-on-submit
+                    cb(eng.results[rid])
+                else:
+                    with self._lock:
+                        self._cbs[rid] = cb
+            stepped = False
+            finished: dict[int, RequestResult] = {}
+            try:
+                if eng.pending():
+                    self.faults.check("serve.router", key=self.name)
+                    finished = eng.step()
+                    stepped = True
+            except BaseException:
+                self._die()
+                return
+            for rid, res in finished.items():
+                with self._lock:
+                    cb2 = self._cbs.pop(rid, None)
+                if cb2 is not None:
+                    cb2(res)
+            try:
+                with self._lock:
+                    self._refresh_view_locked()
+            except BaseException:
+                self._die()
+                return
+            if not stepped:
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    def _die(self) -> None:
+        """Mark dead, then hand every in-flight request back to the
+        router (callbacks fire OUTSIDE the replica lock: they re-enter
+        the router, which may call ``submit`` on other replicas)."""
+        with self._lock:
+            self._dead = True
+            self._view = dict(self._view, healthy=False, goodput=0.0)
+            orphans = list(self._cbs.values())
+            self._cbs.clear()
+            pending = list(self._inbox)
+            self._inbox.clear()
+        if self.on_death is not None:
+            self.on_death(self)
+        for cb in orphans:
+            cb(None)
+        for _req, cb in pending:
+            cb(None)
+
+
+class HttpReplica(ReplicaHandle):
+    """A backend reached over HTTP: submissions POST to a remote
+    ``/v1/generate`` door (typically a single-replica
+    :class:`RouterServer` co-located with the engine), health and
+    digests come from its monitor's ``/snapshot`` + ``/healthz``.
+    Each submission runs in a short-lived daemon thread so the router
+    never blocks on the network; a connection error or non-2xx reply
+    fires the callback with ``None`` — the same failover signal a
+    local pump death produces."""
+
+    def __init__(self, name: str, generate_url: str,
+                 monitor_url: str | None = None,
+                 block_size: int = 0, timeout_s: float = 30.0):
+        self.name = name
+        self.generate_url = generate_url.rstrip("/")
+        self.monitor_url = (monitor_url.rstrip("/")
+                            if monitor_url else None)
+        self.block_size = block_size
+        self.timeout_s = timeout_s
+
+    def submit(self, req: Request, done_cb: DoneCallback) -> None:
+        payload = request_to_json(req)
+
+        def _post() -> None:
+            import urllib.request
+            try:
+                http_req = urllib.request.Request(
+                    self.generate_url + "/v1/generate",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        http_req, timeout=self.timeout_s) as resp:
+                    body = json.loads(resp.read().decode())
+                done_cb(RequestResult(body.get("tokens", []),
+                                      body.get("status", FAILED)))
+            except Exception:
+                done_cb(None)
+
+        threading.Thread(target=_post, daemon=True,
+                         name=f"hvd-router-post-{self.name}").start()
+
+    def _get_json(self, url: str) -> tuple[int, dict]:
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode())
+
+    def probe(self) -> dict:
+        view: dict[str, Any] = {"healthy": False, "inflight": 0,
+                                "queue_depth": 0, "goodput": 1.0,
+                                "free_kv_frac": 1.0, "prefix": None}
+        if self.monitor_url is None:
+            view["healthy"] = True      # no monitor: assume alive
+            return view
+        try:
+            code, _ = self._get_json(self.monitor_url + "/healthz")
+            view["healthy"] = code == 200
+            _, snap = self._get_json(self.monitor_url + "/snapshot")
+        except Exception:
+            return view
+        g = snap.get("gauges", {})
+        view["queue_depth"] = int(g.get("serve.queue_depth", 0))
+        view["inflight"] = int(g.get("serve.queue_depth", 0)
+                               + g.get("serve.decoding", 0)
+                               + g.get("serve.prefilling", 0))
+        view["goodput"] = snap.get("slo", {}).get("goodput", 1.0)
+        total = (g.get("kv.free_blocks", 0)
+                 + g.get("kv.referenced_blocks", 0)
+                 + g.get("kv.cached_blocks", 0))
+        if total > 0:
+            view["free_kv_frac"] = (g.get("kv.free_blocks", 0)
+                                    + g.get("kv.cached_blocks", 0)) / total
+        view["prefix"] = snap.get("prefix")
+        return view
+
+
+def request_to_json(req: Request) -> dict:
+    """The ``POST /v1/generate`` wire form of a :class:`Request`
+    (greedy serving fields only — the router is greedy-only, like
+    :class:`ServeEngine`)."""
+    return {"prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_id": req.eos_id,
+            "deadline_s": req.deadline_s,
+            "max_queue_steps": req.max_queue_steps,
+            "slo_s": req.slo_s,
+            "priority": req.priority}
+
+
+def request_from_json(payload: dict) -> Request:
+    """Parse the wire form back; raises ``ValueError`` on junk (the
+    handler maps that to HTTP 400)."""
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    prompt = payload.get("prompt")
+    if not isinstance(prompt, list) or \
+            not all(isinstance(t, int) for t in prompt):
+        raise ValueError("prompt must be a list of token ids")
+    mnt = payload.get("max_new_tokens")
+    if not isinstance(mnt, int):
+        raise ValueError("max_new_tokens must be an int")
+    return Request(prompt=prompt, max_new_tokens=mnt,
+                   eos_id=payload.get("eos_id"),
+                   deadline_s=payload.get("deadline_s"),
+                   max_queue_steps=payload.get("max_queue_steps"),
+                   slo_s=payload.get("slo_s"),
+                   priority=int(payload.get("priority") or 0))
+
+
+# ---------------------------------------------------------------------------
+# The router itself.
+# ---------------------------------------------------------------------------
+
+
+class _Ticket:
+    """One routed request's lifecycle inside the router: which replica
+    holds it, whether it was shed, and its terminal result.  All fields
+    are mutated under the owning router's lock; ``done`` is the only
+    cross-thread wait point."""
+
+    __slots__ = ("rid", "req", "replica", "shed", "failovers",
+                 "result", "done", "policy")
+
+    def __init__(self, rid: int, req: Request):
+        self.rid = rid
+        self.req = req
+        self.replica: str | None = None
+        self.shed: str | None = None        # shed reason, when shed
+        self.failovers = 0
+        self.result: RequestResult | None = None
+        self.done = threading.Event()
+        self.policy = ""
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    """Routes one front-door HTTP request (the monitor ``_Handler``
+    pattern: short, lock-free, every touched surface thread-safe)."""
+
+    server: "RouterServer._Server"  # type: ignore[assignment]
+
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        router = self.server.router
+        router._scrapes.inc()
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._reply(200, router.metrics.to_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/replicas":
+                self._reply(200, json.dumps(router.replicas_report()),
+                            "application/json")
+            elif path == "/snapshot":
+                snap = router.metrics.snapshot()
+                snap["replicas"] = router.replicas_report()
+                self._reply(200, json.dumps(snap), "application/json")
+            elif path == "/healthz":
+                code, body = router.health()
+                self._reply(code, json.dumps(body), "application/json")
+            else:
+                self._reply(404, "unknown path; try /v1/generate "
+                                 "/replicas /snapshot /healthz "
+                                 "/metrics\n",
+                            "text/plain")
+        except BrokenPipeError:
+            pass
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        router = self.server.router
+        path = self.path.split("?", 1)[0]
+        try:
+            if path != "/v1/generate":
+                self._reply(404, "unknown path; POST /v1/generate\n",
+                            "text/plain")
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n).decode())
+                req = request_from_json(payload)
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, json.dumps({"error": str(e)}),
+                            "application/json")
+                return
+            code, body = router.handle_generate(req)
+            self._reply(code, json.dumps(body), "application/json")
+        except BrokenPipeError:
+            pass
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass        # requests must not spam the job's stderr
+
+
+class RouterServer:
+    """The fleet front door: routes, sheds, fails over, and reports.
+
+    ``replicas`` is a list of :class:`ReplicaHandle`; in-process
+    engines wrap in :class:`LocalReplica` automatically when you pass
+    bare engines.  The HTTP server binds at construction (``port=0``
+    picks an ephemeral port — read ``.port``) and serves after
+    :meth:`start`; the programmatic surface (:meth:`route` /
+    :meth:`result`) works without ever starting HTTP, which is how the
+    bench arm and most tests drive it.
+
+    Thread model: handler threads call :meth:`route`/:meth:`result`,
+    replica pump/POST threads call the completion callbacks, one
+    poller thread refreshes views — all cross-thread state lives
+    behind ``_lock`` (see ``_GUARDED_BY_LOCK``).  Lock order is
+    router → replica; replica callbacks always fire with no replica
+    lock held, so the reverse edge never forms."""
+
+    _GUARDED_BY_LOCK = ("_tickets", "_views", "_shadows", "_inflight",
+                        "_routed", "_dead", "_next_rid")
+
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = True
+        router: "RouterServer"
+
+    def __init__(self, replicas: Sequence[Any], *,
+                 policy: "RoutingPolicy | str | None" = None,
+                 registry: "metrics_mod.MetricsRegistry | None" = None,
+                 faults: "faults_mod.FaultRegistry | None" = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 min_goodput: float | None = None,
+                 min_free_kv: float | None = None,
+                 imbalance: float | None = None,
+                 poll_s: float | None = None,
+                 shadow_max_paths: int = 4096):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas: list[ReplicaHandle] = []
+        names = set()
+        for i, r in enumerate(replicas):
+            if not isinstance(r, ReplicaHandle):
+                r = LocalReplica(r, name=f"replica{i}", faults=faults)
+            if r.name in names:
+                raise ValueError(f"duplicate replica name {r.name!r}")
+            names.add(r.name)
+            if isinstance(r, LocalReplica) and r.on_death is None:
+                r.on_death = self._on_replica_death
+            self.replicas.append(r)
+        self.policy = resolve_routing_policy(policy)
+        self.metrics = (registry if registry is not None
+                        else metrics_mod.MetricsRegistry())
+        self.min_goodput = (min_goodput if min_goodput is not None else
+                            env_float("HVD_TPU_ROUTER_MIN_GOODPUT", 0.0))
+        self.min_free_kv = (min_free_kv if min_free_kv is not None else
+                            env_float("HVD_TPU_ROUTER_MIN_FREE_KV", 0.0))
+        self.imbalance = (imbalance if imbalance is not None else
+                          env_float("HVD_TPU_ROUTER_IMBALANCE", 4.0))
+        self.poll_s = (poll_s if poll_s is not None else
+                       env_float("HVD_TPU_ROUTER_POLL_S", 0.05))
+
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._tickets: dict[int, _Ticket] = {}
+        self._views: dict[str, dict] = {}
+        self._shadows: dict[str, ShadowPrefixIndex] = {
+            r.name: ShadowPrefixIndex(r.block_size, shadow_max_paths)
+            for r in self.replicas}
+        self._inflight: dict[str, int] = {r.name: 0
+                                          for r in self.replicas}
+        self._routed: dict[str, int] = {r.name: 0 for r in self.replicas}
+        self._dead: set[str] = set()
+
+        # Registered up front (literal names — the HVD005 contract) so
+        # router snapshots are schema-stable from request 0; the
+        # per-decision bump composes "router.routed." + policy.name.
+        self.metrics.counter("router.routed.round_robin")
+        self.metrics.counter("router.routed.least_loaded")
+        self.metrics.counter("router.routed.prefix_affinity")
+        self.metrics.counter("router.requests")
+        self.metrics.counter("router.sheds")
+        self.metrics.counter("router.failovers")
+        self.metrics.counter("router.replica_deaths")
+        self.metrics.counter("router.affinity_fallbacks")
+        self.metrics.histogram("router.affinity_hit_tokens")
+        self.metrics.gauge("router.replicas_healthy").set(
+            len(self.replicas))
+        self.metrics.gauge("router.inflight").set(0)
+        self.metrics.gauge("router.shadow_index_bytes").set(0)
+        # Scrape odometer off the shared generation cell (the monitor
+        # trick) so idle /metrics scrapes stay render-cached.
+        self._scrapes = self.metrics.counter("monitor.scrapes")
+        self._scrapes._gen = metrics_mod._Gen()
+
+        self._httpd = RouterServer._Server((host, port), _RouterHandler)
+        self._httpd.router = self
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread: threading.Thread | None = None
+        self._poll_stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RouterServer":
+        """Serve HTTP and start the replica poller (idempotent)."""
+        if self._http_thread is None:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"hvd-router-:{self.port}", daemon=True)
+            self._http_thread.start()
+        if self._poll_thread is None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="hvd-router-poll",
+                daemon=True)
+            self._poll_thread.start()
+        return self
+
+    def stop(self, stop_replicas: bool = True) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+            self._poll_thread = None
+        if self._http_thread is not None:
+            self._httpd.shutdown()
+            self._http_thread.join(timeout=5)
+            self._http_thread = None
+        self._httpd.server_close()
+        if stop_replicas:
+            for r in self.replicas:
+                r.stop()
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, req: Request) -> int:
+        """Admit-or-shed, choose a replica, submit.  Returns the router
+        request id (poll :meth:`result`); a shed request gets a
+        terminal ``REJECTED`` result immediately."""
+        self.metrics.counter("router.requests").inc()
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            ticket = _Ticket(rid, req)
+            self._tickets[rid] = ticket
+            shed = self._admission_locked()
+            if shed is not None:
+                self._shed_locked(ticket, shed)
+                return rid
+            handle, info = self._place_locked(ticket)
+        self.metrics.event("router.route", rid=rid, replica=handle.name,
+                           policy=ticket.policy, **info)
+        handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
+        return rid
+
+    def result(self, rid: int,
+               timeout: float | None = None) -> RequestResult | None:
+        """Block for a routed request's terminal result (``None`` on
+        timeout — the request is still in flight somewhere)."""
+        with self._lock:
+            ticket = self._tickets.get(rid)
+        if ticket is None:
+            raise KeyError(f"unknown router rid {rid}")
+        if not ticket.done.wait(timeout):
+            return None
+        return ticket.result
+
+    def handle_generate(self, req: Request) -> tuple[int, dict]:
+        """The ``POST /v1/generate`` body: route, wait, and shape the
+        JSON reply.  Shed requests answer 429 (back off and retry is
+        the right client response to load shedding); every other
+        terminal status is a 200 whose ``status`` field speaks."""
+        rid = self.route(req)
+        res = self.result(rid, timeout=None)
+        with self._lock:
+            ticket = self._tickets[rid]
+            body = {"rid": rid, "status": res.status,
+                    "tokens": list(res),
+                    "replica": ticket.replica,
+                    "failovers": ticket.failovers}
+            if ticket.shed is not None:
+                body["shed"] = ticket.shed
+            if res.error is not None:
+                body["error"] = str(res.error)
+            code = 429 if ticket.shed is not None else 200
+        return code, body
+
+    def _admission_locked(self) -> str | None:
+        """Shed reason, or ``None`` to admit.  Fleet goodput / free-KV
+        are means over the healthy replicas' last-polled views; a
+        never-polled replica counts as healthy and empty (no evidence
+        of badness — exactly the SLO window's empty-window stance)."""
+        healthy = [r.name for r in self.replicas
+                   if r.name not in self._dead]
+        if not healthy:
+            return "no_replicas"
+        if self.min_goodput > 0:
+            vals = [self._views.get(n, {}).get("goodput", 1.0)
+                    for n in healthy]
+            if sum(vals) / len(vals) < self.min_goodput:
+                return "goodput"
+        if self.min_free_kv > 0:
+            vals = [self._views.get(n, {}).get("free_kv_frac", 1.0)
+                    for n in healthy]
+            if sum(vals) / len(vals) < self.min_free_kv:
+                return "free_kv"
+        return None
+
+    def _shed_locked(self, ticket: _Ticket, reason: str) -> None:
+        ticket.shed = reason
+        ticket.result = RequestResult([], REJECTED)
+        self.metrics.counter("router.sheds").inc()
+        self.metrics.event("router.shed", rid=ticket.rid, reason=reason)
+        ticket.done.set()
+
+    def _place_locked(
+            self, ticket: _Ticket) -> tuple[ReplicaHandle, dict]:
+        """Pick a healthy replica with the policy and book the ticket
+        onto it (caller submits outside the lock); returns the handle
+        plus the policy's info dict for the ``router.route`` event."""
+        candidates = [r.name for r in self.replicas
+                      if r.name not in self._dead]
+        ctx = RoutingContext(self._views, self._shadows, self._inflight,
+                             self.imbalance)
+        name, info = self.policy.choose(candidates, ticket.req, ctx)
+        ticket.replica = name
+        ticket.policy = self.policy.name
+        self._routed[name] = self._routed.get(name, 0) + 1
+        self._inflight[name] = self._inflight.get(name, 0) + 1
+        self.metrics.counter("router.routed." + self.policy.name).inc()
+        self.metrics.gauge("router.inflight").set(
+            sum(self._inflight.values()))
+        if "affinity_hit_tokens" in info:
+            self.metrics.histogram("router.affinity_hit_tokens").observe(
+                info["affinity_hit_tokens"])
+        if info.get("fallback"):
+            self.metrics.counter("router.affinity_fallbacks").inc()
+        self._shadows[name].observe(ticket.req.prompt)
+        return self._handle(name), info
+
+    def _handle(self, name: str) -> ReplicaHandle:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    # -- completion + failover ---------------------------------------------
+
+    def _on_done(self, ticket: _Ticket,
+                 res: "RequestResult | None") -> None:
+        """Completion callback from a replica thread.  A real result is
+        terminal; ``None`` means the replica died with this request in
+        flight — re-enqueue it on a survivor (replay from the full
+        prompt is bit-identical) or fail it when the fleet is gone."""
+        if res is not None:
+            with self._lock:
+                if ticket.done.is_set():
+                    return
+                ticket.result = res
+                if ticket.replica is not None:
+                    n = self._inflight.get(ticket.replica, 1)
+                    self._inflight[ticket.replica] = max(n - 1, 0)
+                self.metrics.gauge("router.inflight").set(
+                    sum(self._inflight.values()))
+            ticket.done.set()
+            return
+        with self._lock:
+            if ticket.done.is_set():
+                return
+            old = ticket.replica
+            if old is not None:
+                n = self._inflight.get(old, 1)
+                self._inflight[old] = max(n - 1, 0)
+            if all(r.name in self._dead for r in self.replicas):
+                ticket.result = RequestResult(
+                    [], FAILED,
+                    RuntimeError("no healthy replicas for failover"))
+                self.metrics.gauge("router.inflight").set(
+                    sum(self._inflight.values()))
+                ticket.done.set()
+                return
+            ticket.failovers += 1
+            self.metrics.counter("router.failovers").inc()
+            handle, info = self._place_locked(ticket)
+        self.metrics.event("router.failover", rid=ticket.rid,
+                           src=old, dst=handle.name, **info)
+        handle.submit(ticket.req,
+                      lambda res2, t=ticket: self._on_done(t, res2))
+
+    def _on_replica_death(self, replica: ReplicaHandle) -> None:
+        self._mark_dead(replica.name)
+
+    def _mark_dead(self, name: str) -> None:
+        with self._lock:
+            if name in self._dead:
+                return
+            self._dead.add(name)
+            healthy = len(self.replicas) - len(self._dead)
+        self.metrics.counter("router.replica_deaths").inc()
+        self.metrics.gauge("router.replicas_healthy").set(healthy)
+        self.metrics.event("router.replica_death", replica=name)
+
+    # -- polling + reports -------------------------------------------------
+
+    def poll_now(self) -> None:
+        """One synchronous poll pass (the poller thread's body; tests
+        and the bench call it directly for deterministic views)."""
+        for r in list(self.replicas):
+            try:
+                view = r.probe()
+            except Exception:
+                view = {"healthy": False}
+            with self._lock:
+                self._views[r.name] = view
+                self._shadows[r.name].load(view.get("prefix"))
+            if not view.get("healthy", False):
+                self._mark_dead(r.name)      # no-op when already dead
+        self.metrics.gauge("router.shadow_index_bytes").set(
+            self._shadow_bytes())
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.poll_s):
+            self.poll_now()
+
+    def _shadow_bytes(self) -> int:
+        with self._lock:
+            return sum(s.approx_footprint_bytes()
+                       for s in self._shadows.values())
+
+    def health(self) -> tuple[int, dict]:
+        """``GET /healthz``: 200 while at least one replica is
+        routable, 503 once the whole fleet is dead."""
+        with self._lock:
+            healthy = [r.name for r in self.replicas
+                       if r.name not in self._dead]
+            body = {"ok": bool(healthy), "replicas": len(self.replicas),
+                    "healthy": len(healthy), "pid": os.getpid()}
+        return (200 if body["ok"] else 503), body
+
+    def replicas_report(self) -> list[dict]:
+        """``GET /replicas``: per-replica routing/health detail the
+        label-less Prometheus names can't carry."""
+        out = []
+        with self._lock:
+            for r in self.replicas:
+                shadow = self._shadows[r.name]
+                out.append({
+                    "name": r.name,
+                    "healthy": r.name not in self._dead,
+                    "routed": self._routed.get(r.name, 0),
+                    "inflight": self._inflight.get(r.name, 0),
+                    "view": dict(self._views.get(r.name, {}),
+                                 prefix=None),
+                    "shadow_paths": len(shadow),
+                    "shadow_block_size": shadow.block_size,
+                })
+        return out
+
+    def memory_report(self) -> dict:
+        """Host-side footprint of the router's own bookkeeping — the
+        shadow indexes dominate; ``approx_footprint_bytes`` is their
+        sum (also the ``router.shadow_index_bytes`` gauge)."""
+        with self._lock:
+            per_replica = {n: s.approx_footprint_bytes()
+                           for n, s in self._shadows.items()}
+            tickets = len(self._tickets)
+        total = sum(per_replica.values())
+        self.metrics.gauge("router.shadow_index_bytes").set(total)
+        return {"approx_footprint_bytes": total,
+                "shadow_index_bytes": per_replica,
+                "tickets": tickets}
+
+
+def maybe_start_router(replicas: Sequence[Any],
+                       **kwargs: Any) -> RouterServer | None:
+    """Start a front door when ``HVD_TPU_ROUTER_PORT`` is set (the
+    ``maybe_start_monitor`` contract: unset → None silently,
+    unparsable/taken port → warn, never crash the job)."""
+    raw = os.environ.get("HVD_TPU_ROUTER_PORT")
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        warnings.warn(f"HVD_TPU_ROUTER_PORT={raw!r} is not an int; "
+                      "router disabled", RuntimeWarning, stacklevel=2)
+        return None
+    try:
+        return RouterServer(replicas, port=port, **kwargs).start()
+    except OSError as e:
+        warnings.warn(f"router port {port} unavailable ({e}); "
+                      "router disabled", RuntimeWarning, stacklevel=2)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Bench arm: affinity routing vs round robin over an in-process fleet.
+# ---------------------------------------------------------------------------
+
+
+def measure_router_fleet(
+    params: dict, cfg: Any, *,
+    n_replicas: int = 3, n_groups: int = 4, waves: int = 6,
+    prefix_blocks: int = 4, suffix_len: int = 4,
+    max_new_tokens: int = 8, n_slots: int = 4,
+    chunk: int = 16, max_len: int | None = None,
+    policies: Sequence[str] = ("round_robin", "prefix_affinity"),
+) -> dict:
+    """Fleet prefix hit rate and throughput, affinity vs round robin
+    (the ``serve_router_*`` bench metrics).
+
+    The workload is ``n_groups`` families sharing a
+    ``prefix_blocks * chunk``-token prefix, submitted in ``waves``
+    rounds of one request per family (each wave waits for the previous
+    — the steady drip of a production prompt family, and it makes hit
+    accounting deterministic).  Keep ``n_groups`` non-multiple of
+    ``n_replicas``: with ``G == R`` round robin aligns each family to
+    one replica by accident and the contrast vanishes.  Each policy serves the identical
+    workload on a fresh ``n_replicas``-engine fleet whose programs are
+    pre-compiled by an untimed disjoint warmup, so the timed passes
+    compare *routing* — affinity concentrates each family on one
+    replica (first wave misses, the rest hit); round robin smears it
+    across the fleet (one cold miss per replica per family).  Outputs
+    are asserted token-identical across policies (routing must never
+    change tokens).  Returns per-policy
+    ``serve_router_hit_rate_<policy>`` /
+    ``serve_router_tokens_per_sec_<policy>`` plus the affinity-minus-
+    round-robin ``serve_router_hit_rate_gain`` and workload shape."""
+    from horovod_tpu.serving_scheduler import ServeEngine
+
+    prefix_len = prefix_blocks * chunk
+    if max_len is None:
+        need = prefix_len + suffix_len + max_new_tokens + chunk
+        max_len = -(-need // chunk) * chunk     # block-aligned
+    workload: list[Request] = []
+    for w in range(waves):
+        for g in range(n_groups):
+            prefix = [(11 + 13 * g + i) % 89 + 2
+                      for i in range(prefix_len)]
+            suffix = [(29 + 7 * g + 3 * w + i) % 89 + 2
+                      for i in range(suffix_len)]
+            workload.append(Request(prompt=prefix + suffix,
+                                    max_new_tokens=max_new_tokens))
+
+    out: dict[str, Any] = {
+        "serve_router_replicas": n_replicas,
+        "serve_router_groups": n_groups,
+        "serve_router_waves": waves,
+        "n_requests": len(workload),
+        "chunk": chunk,
+        "n_slots": n_slots,
+    }
+    outputs: dict[str, list[list[int]]] = {}
+    for policy in policies:
+        engines = [ServeEngine(params, cfg, n_slots=n_slots,
+                               max_len=max_len, chunk=chunk,
+                               prefix_cache=True)
+                   for _ in range(n_replicas)]
+        # Untimed warmup: compile every program with a token family the
+        # workload never shares a first chunk with, so the timed hit
+        # counters start from a cold radix for the measured prompts.
+        for eng in engines:
+            warm = eng.run([Request(prompt=[1] * (chunk + 1),
+                                    max_new_tokens=2)])
+            assert all(r.ok for r in warm)
+        router = RouterServer(engines, policy=policy)
+        try:
+            hits0 = sum(e.prefix_counters["hits"] for e in engines)
+            toks: list[list[int]] = []
+            t0 = time.perf_counter()
+            for w in range(waves):
+                wave = workload[w * n_groups:(w + 1) * n_groups]
+                rids = [router.route(r) for r in wave]
+                toks.extend(list(router.result(rid)) for rid in rids)
+            dt = time.perf_counter() - t0
+            hits = sum(e.prefix_counters["hits"] for e in engines) - hits0
+            n_tokens = sum(len(t) for t in toks)
+            outputs[policy] = toks
+            out[f"serve_router_hit_rate_{policy}"] = hits / len(workload)
+            out[f"serve_router_tokens_per_sec_{policy}"] = n_tokens / dt
+        finally:
+            router.stop()
+    first = next(iter(outputs))
+    for policy, toks in outputs.items():
+        assert toks == outputs[first], \
+            f"routing changed tokens: {first} vs {policy}"
+    if "round_robin" in outputs and "prefix_affinity" in outputs:
+        out["serve_router_hit_rate_gain"] = (
+            out["serve_router_hit_rate_prefix_affinity"]
+            - out["serve_router_hit_rate_round_robin"])
+    return out

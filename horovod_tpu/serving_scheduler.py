@@ -543,6 +543,11 @@ class ServeEngine:
         snap = self.metrics.snapshot()
         snap["slo"] = self.slo_report()
         snap["memory"] = mem
+        if self.prefix is not None:
+            # Bounded radix-path digest summary: what a prefix-affinity
+            # router needs to know about THIS replica's cached prefixes
+            # (rides /snapshot via the monitor for free).
+            snap["prefix"] = self.prefix.key_digest()
         if self.prof is not None:
             snap["profile"] = self.prof.report()
         return snap
@@ -679,10 +684,13 @@ class ServeEngine:
         Validation happens here so a rejected request never holds a
         queue position."""
         L = len(req.prompt)
-        if L < 1:
-            raise ValueError("empty prompt")
-        if req.max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+        if L < 1 or req.max_new_tokens < 1:
+            # Malformed client data (as opposed to caller programming
+            # errors below, which still raise): reject with the same
+            # terminal-status contract the queue-overflow shed and the
+            # router's admission-control shed use, so one status check
+            # covers every "the fleet would not serve this" path.
+            return self._reject_submit(req, L)
         if req.temperature not in (None, 0.0) or req.sample_key is not None:
             raise ValueError(
                 "ServeEngine is greedy-only; serve sampled requests "
@@ -724,6 +732,27 @@ class ServeEngine:
                            max_new_tokens=req.max_new_tokens)
         if self.timeline is not None:
             self.timeline.async_start("serving.requests", "REQ", rid)
+        return rid
+
+    def _reject_submit(self, req: Request, L: int) -> int:
+        """Terminal ``REJECTED`` for a request invalid on its face
+        (empty prompt, non-positive budget).  It gets a real rid, a
+        trace, and the full submit/reject event pair — never a queue
+        position — so callers poll ``results`` exactly as they would
+        for a load-shed request."""
+        rid = self._next_id
+        self._next_id += 1
+        now = time.monotonic()
+        self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
+                                 enqueue_step=self.step_index)
+        self._slo_targets[rid] = req.slo_s
+        self.metrics.counter("serve.requests_submitted").inc()
+        self.metrics.event("serve.submit", rid=rid, step=self.step_index,
+                           prompt_len=L,
+                           max_new_tokens=req.max_new_tokens)
+        if self.timeline is not None:
+            self.timeline.async_start("serving.requests", "REQ", rid)
+        self._finish_queued(_QueueEntry(rid=rid, req=req), REJECTED)
         return rid
 
     def cancel(self, rid: int) -> bool:

@@ -1,0 +1,78 @@
+"""Worker for the real-socket router test (tests/test_router.py).
+
+Launched by ``test_multiprocess_router_real_sockets`` as N OS
+processes, each a pure-stdlib HTTP client of ONE shared
+:class:`~horovod_tpu.router.RouterServer` living in the launcher
+process (``ROUTER_URL`` env) — no jax import, no coordination env:
+this worker IS the external client the router's front door exists
+for.  Every worker sends the SAME deterministic prompts, so greedy
+determinism makes the token payloads byte-identical across workers no
+matter how the router interleaves them over replicas (the launcher
+asserts it).  Also pokes the failure surface from outside: a
+malformed body must answer 400 without wedging the server.
+
+Prints one final line ``WORKER_OK {json}`` on success.
+"""
+
+import faulthandler
+import json
+import os
+import urllib.error
+import urllib.request
+
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
+
+
+def _post(url: str, body: bytes, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def main() -> None:
+    base = os.environ["ROUTER_URL"].rstrip("/")
+    wid = int(os.environ.get("ROUTER_WORKER_ID", "0"))
+
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["ok"] and health["healthy"] >= 1, health
+
+    # Same prompts from every worker: shared 17-token stem (2+ cache
+    # blocks) plus a short per-request tail — the router may place
+    # them anywhere, the tokens may not care.
+    shared = list(range(2, 19))
+    results = []
+    for i in range(3):
+        body = json.dumps({"prompt": shared + [40 + i],
+                           "max_new_tokens": 4}).encode()
+        with _post(base + "/v1/generate", body) as r:
+            assert r.status == 200, r.status
+            out = json.loads(r.read())
+        assert out["status"] == "OK", out
+        results.append({"prompt_tail": 40 + i, "tokens": out["tokens"]})
+
+    # A garbage body is the client's fault, not the fleet's: 400, and
+    # the very next good request still serves.
+    try:
+        _post(base + "/v1/generate", b'{"prompt": "not tokens"}',
+              timeout=10)
+        raise AssertionError("malformed body did not 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+    with urllib.request.urlopen(base + "/replicas", timeout=10) as r:
+        replicas = json.loads(r.read())
+    assert any(rep["healthy"] for rep in replicas), replicas
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "router_requests" in text
+
+    del wid  # identity lives in the launcher; payloads must match
+    print("WORKER_OK " + json.dumps({"results": results},
+                                    sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

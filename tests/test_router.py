@@ -1,0 +1,404 @@
+"""Multi-replica serving router (horovod_tpu/router.py).
+
+Three oracles pin the router, all step-counted / socket-real, no
+sleeps in any assertion path:
+
+1. *Placement is pure*: every routing policy is a function of
+   (candidates, request, context) — unit-tested against synthetic
+   contexts with no engine behind them, and prefix_affinity must
+   concentrate a shared-prefix workload onto one replica while
+   round_robin provably spreads it.
+2. *Failover is invisible*: killing a replica mid-stream (the
+   ``serve.router`` fault site) re-enqueues its in-flight requests to
+   survivors and every output stays bit-identical to the solo
+   ``llama.generate`` run — greedy replay from the full prompt hides
+   the death point by construction.
+3. *The wire is honest*: shed → 429, junk body → 400, everything else
+   → 200 with a terminal ``status`` field; real OS processes hammering
+   one router over real sockets read byte-identical token payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.faults import FaultRegistry
+from horovod_tpu.models import llama
+from horovod_tpu.prefix_cache import chunk_path_digests
+from horovod_tpu.router import (
+    LeastLoadedPolicy, PrefixAffinityPolicy, RoundRobinPolicy,
+    RouterServer, RoutingContext, ShadowPrefixIndex, request_from_json,
+    request_to_json, resolve_routing_policy,
+)
+from horovod_tpu.serving import FAILED, OK, REJECTED, Request
+from horovod_tpu.serving_scheduler import ServeEngine
+
+pytestmark = pytest.mark.router
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROUTER_WORKER = os.path.join(HERE, "multiprocess_router_worker.py")
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    return cfg, params
+
+
+def _engines(params, cfg, n, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("prefix_cache", True)
+    return [ServeEngine(params, cfg, **kw) for _ in range(n)]
+
+
+def _solo(params, cfg, prompt, n_new, max_len=64):
+    return np.asarray(llama.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n_new, max_len=max_len,
+    ))[0]
+
+
+# -- shadow index + policies: no engine, no socket ---------------------------
+
+
+def test_shadow_prefix_index_matching():
+    idx = ShadowPrefixIndex(block_size=4)
+    toks = list(range(10, 23))                      # 3 full blocks + tail
+    idx.observe(toks)
+    assert len(idx) == 3
+    assert idx.match_tokens(toks) == 12             # whole cached stem
+    assert idx.match_tokens(toks[:9]) == 8          # partial block drops
+    # A diverging 2nd block stops the contiguous match after block 1.
+    assert idx.match_tokens(toks[:4] + [99] * 8) == 4
+    assert idx.match_tokens([99, 98, 97, 96]) == 0
+    # load() merges a replica's own key_digest() summary and adopts its
+    # block size on a cold shadow.
+    cold = ShadowPrefixIndex()
+    assert cold.match_tokens(toks) == 0
+    cold.load({"block_size": 4,
+               "paths": chunk_path_digests(toks, 4)})
+    assert cold.block_size == 4
+    assert cold.match_tokens(toks) == 12
+    assert cold.approx_footprint_bytes() > 0
+
+
+def test_shadow_prefix_index_fifo_bound():
+    idx = ShadowPrefixIndex(block_size=2, max_paths=4)
+    for i in range(8):
+        idx.observe([i * 10, i * 10 + 1])           # 8 distinct digests
+    assert len(idx) == 4                            # oldest 4 evicted
+    assert idx.match_tokens([0, 1]) == 0
+    assert idx.match_tokens([70, 71]) == 2
+
+
+def _ctx(inflight, shadows=None, views=None, imbalance=4.0):
+    return RoutingContext(views or {}, shadows or {}, inflight,
+                          imbalance)
+
+
+def test_round_robin_and_least_loaded_policies():
+    rr = RoundRobinPolicy()
+    names = [rr.choose(["a", "b", "c"], None, _ctx({}))[0]
+             for _ in range(5)]
+    assert names == ["a", "b", "c", "a", "b"]
+    ll = LeastLoadedPolicy()
+    assert ll.choose(["a", "b"], None, _ctx({"a": 3, "b": 1}))[0] == "b"
+    # Equal queues: the SLO-missing replica is effectively fuller.
+    views = {"a": {"goodput": 0.4}, "b": {"goodput": 0.9}}
+    assert ll.choose(["a", "b"], None,
+                     _ctx({"a": 2, "b": 2}, views=views))[0] == "b"
+
+
+def test_prefix_affinity_policy_and_imbalance_fallback():
+    stem = list(range(10, 27))                      # 17 tokens, 2 blocks
+    hot, cold = ShadowPrefixIndex(8), ShadowPrefixIndex(8)
+    hot.observe(stem)
+    shadows = {"hot": hot, "cold": cold}
+    pol = PrefixAffinityPolicy()
+    req = Request(prompt=stem + [99], max_new_tokens=2)
+
+    name, info = pol.choose(["hot", "cold"], req,
+                            _ctx({"hot": 0, "cold": 0}, shadows))
+    assert name == "hot"
+    assert info == {"affinity_hit_tokens": 16, "fallback": False}
+    # No match anywhere: least-loaded, hit length 0.
+    name, info = pol.choose(["hot", "cold"],
+                            Request(prompt=[99, 98], max_new_tokens=2),
+                            _ctx({"hot": 2, "cold": 0}, shadows))
+    assert name == "cold" and info["affinity_hit_tokens"] == 0
+    # Affinity choice 5 requests deeper than the emptiest replica with
+    # imbalance=4: locality loses to load, flagged as a fallback.
+    name, info = pol.choose(["hot", "cold"], req,
+                            _ctx({"hot": 5, "cold": 0}, shadows))
+    assert name == "cold" and info["fallback"] is True
+
+
+def test_resolve_routing_policy(monkeypatch):
+    assert resolve_routing_policy("round_robin").name == "round_robin"
+    inst = LeastLoadedPolicy()
+    assert resolve_routing_policy(inst) is inst
+    monkeypatch.setenv("HVD_TPU_ROUTER_POLICY", "least_loaded")
+    assert resolve_routing_policy(None).name == "least_loaded"
+    monkeypatch.delenv("HVD_TPU_ROUTER_POLICY")
+    assert resolve_routing_policy(None).name == "prefix_affinity"
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        resolve_routing_policy("best_effort")
+
+
+def test_request_json_roundtrip():
+    req = Request(prompt=[1, 2, 3], max_new_tokens=5, priority=2,
+                  slo_s=1.5)
+    back = request_from_json(request_to_json(req))
+    assert back.prompt == [1, 2, 3] and back.max_new_tokens == 5
+    assert back.priority == 2 and back.slo_s == 1.5
+    with pytest.raises(ValueError, match="list of token ids"):
+        request_from_json({"prompt": "abc", "max_new_tokens": 2})
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        request_from_json({"prompt": [1], "max_new_tokens": "2"})
+    with pytest.raises(ValueError, match="JSON object"):
+        request_from_json([1, 2])
+    # explicit null priority is absent-priority, not a crash
+    assert request_from_json({"prompt": [1], "max_new_tokens": 1,
+                              "priority": None}).priority == 0
+
+
+# -- routing through real engines --------------------------------------------
+
+
+def test_affinity_concentrates_shared_prefix(world):
+    """The headline behavior: a shared-prefix workload lands on ONE
+    replica under prefix_affinity (fleet cache hits) while round_robin
+    provably spreads it — and the tokens are identical either way."""
+    cfg, params = world
+    stem = list(range(2, 19))                       # 2 full blocks of 8
+    reqs = [Request(prompt=stem + [40 + i], max_new_tokens=4)
+            for i in range(4)]
+    solo = {i: _solo(params, cfg, r.prompt, 4) for i, r in
+            enumerate(reqs)}
+
+    outs = {}
+    for policy in ("round_robin", "prefix_affinity"):
+        router = RouterServer(_engines(params, cfg, 2), policy=policy)
+        try:
+            rids = [router.route(r) for r in reqs]
+            res = [router.result(rid, timeout=60) for rid in rids]
+            assert all(r.status == OK for r in res)
+            for i, r in enumerate(res):
+                np.testing.assert_array_equal(
+                    np.asarray(list(r), np.int64),
+                    solo[i].astype(np.int64))
+            outs[policy] = {rep["name"]: rep["routed"]
+                            for rep in router.replicas_report()}
+            snap = router.metrics.snapshot()
+            assert snap["counters"][f"router.routed.{policy}"] == 4
+            if policy == "prefix_affinity":
+                hist = snap["histograms"]["router.affinity_hit_tokens"]
+                assert hist["count"] == 4
+                assert hist["max"] == 16.0      # warmed shadow matched
+        finally:
+            router.stop()
+    assert sorted(outs["round_robin"].values()) == [2, 2]
+    assert sorted(outs["prefix_affinity"].values()) == [0, 4]
+
+
+def test_admission_shed_and_rejected_passthrough(world):
+    cfg, params = world
+    router = RouterServer(_engines(params, cfg, 1),
+                          policy="round_robin", min_goodput=2.0)
+    try:
+        rid = router.route(Request(prompt=[3, 5], max_new_tokens=2))
+        res = router.result(rid, timeout=10)
+        assert res.status == REJECTED and list(res) == []
+        code, body = router.handle_generate(
+            Request(prompt=[3, 5], max_new_tokens=2))
+        assert code == 429 and body["shed"] == "goodput"
+        snap = router.metrics.snapshot()
+        assert snap["counters"]["router.sheds"] == 2
+        assert snap["counters"]["router.requests"] == 2
+    finally:
+        router.stop()
+
+    # An engine-level REJECTED (empty prompt) rides back through the
+    # router as a terminal result — not a failover, not an exception.
+    router = RouterServer(_engines(params, cfg, 1),
+                          policy="round_robin")
+    try:
+        rid = router.route(Request(prompt=[], max_new_tokens=2))
+        res = router.result(rid, timeout=30)
+        assert res.status == REJECTED
+        assert router.metrics.snapshot()["counters"]["router.failovers"] \
+            == 0
+    finally:
+        router.stop()
+
+
+def test_failover_outputs_bit_identical(world):
+    """Kill a replica mid-stream via the ``serve.router`` fault site:
+    its in-flight requests re-enqueue to the survivor and every token
+    stream is bit-identical to the solo run — the failover acceptance
+    bar."""
+    cfg, params = world
+    fr = FaultRegistry()
+    router = RouterServer(_engines(params, cfg, 2),
+                          policy="round_robin", faults=fr)
+    fr.inject("serve.router", key="replica0", on_hit=3, permanent=True)
+    try:
+        reqs = [Request(prompt=[2 + i, 3 + i, 5 + i, 7 + i],
+                        max_new_tokens=6) for i in range(4)]
+        rids = [router.route(r) for r in reqs]
+        res = [router.result(rid, timeout=60) for rid in rids]
+        assert all(r.status == OK for r in res)
+        for req, r in zip(reqs, res):
+            np.testing.assert_array_equal(
+                np.asarray(list(r), np.int64),
+                _solo(params, cfg, req.prompt, 6).astype(np.int64))
+        snap = router.metrics.snapshot()
+        assert snap["counters"]["router.replica_deaths"] == 1
+        assert snap["counters"]["router.failovers"] >= 1
+        assert snap["gauges"]["router.replicas_healthy"] == 1
+        report = {rep["name"]: rep for rep in router.replicas_report()}
+        assert not report["replica0"]["healthy"]
+        assert report["replica1"]["healthy"]
+        # With the whole fleet dead, routing fails terminally (and
+        # /healthz goes 503) instead of hanging a client forever.
+        fr.inject("serve.router", key="replica1", on_hit=1,
+                  permanent=True)
+        rid = router.route(Request(prompt=[9, 8, 7], max_new_tokens=4))
+        res = router.result(rid, timeout=60)
+        assert res.status == FAILED
+        assert "no healthy replicas" in str(res.error)
+        code, body = router.health()
+        assert code == 503 and body["healthy"] == 0
+    finally:
+        router.stop()
+        fr.clear()
+
+
+def test_memory_report_counts_shadow_indexes(world):
+    cfg, params = world
+    router = RouterServer(_engines(params, cfg, 2),
+                          policy="prefix_affinity")
+    try:
+        rid = router.route(Request(prompt=list(range(2, 19)),
+                                   max_new_tokens=2))
+        assert router.result(rid, timeout=60).status == OK
+        mem = router.memory_report()
+        assert mem["approx_footprint_bytes"] == sum(
+            mem["shadow_index_bytes"].values())
+        assert set(mem["shadow_index_bytes"]) == {"replica0", "replica1"}
+        assert router.metrics.snapshot()["gauges"][
+            "router.shadow_index_bytes"] == mem["approx_footprint_bytes"]
+    finally:
+        router.stop()
+
+
+def test_poller_merges_replica_digests(world):
+    """poll_now() pulls each replica's key_digest() summary into its
+    shadow — the authoritative feed: a prompt served OUTSIDE the
+    router (warmed directly on the engine) still attracts affinity."""
+    cfg, params = world
+    engines = _engines(params, cfg, 2)
+    stem = list(range(2, 19))
+    engines[1].run([Request(prompt=stem + [77], max_new_tokens=2)])
+    router = RouterServer(engines, policy="prefix_affinity")
+    try:
+        router.poll_now()
+        rid = router.route(Request(prompt=stem + [88],
+                                   max_new_tokens=2))
+        assert router.result(rid, timeout=60).status == OK
+        report = {rep["name"]: rep for rep in router.replicas_report()}
+        assert report["replica1"]["routed"] == 1
+        assert report["replica0"]["routed"] == 0
+        view = report["replica1"]["view"]
+        assert view["healthy"] and view["free_kv_frac"] > 0
+    finally:
+        router.stop()
+
+
+# -- the HTTP front door ------------------------------------------------------
+
+
+def test_http_front_door(world):
+    cfg, params = world
+    router = RouterServer(_engines(params, cfg, 1),
+                          policy="round_robin").start()
+    base = f"http://{router.host}:{router.port}"
+    try:
+        body = json.dumps({"prompt": [5, 17, 42],
+                           "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            base + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert out["status"] == OK and out["replica"] == "replica0"
+        np.testing.assert_array_equal(
+            np.asarray(out["tokens"], np.int64),
+            _solo(params, cfg, [5, 17, 42], 4).astype(np.int64))
+
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert json.loads(r.read())["ok"]
+        with urllib.request.urlopen(base + "/replicas", timeout=10) as r:
+            assert json.loads(r.read())[0]["routed"] == 1
+        with urllib.request.urlopen(base + "/snapshot", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["counters"]["router.requests"] == 1
+        assert snap["replicas"][0]["name"] == "replica0"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "router_requests 1" in text
+        assert "# HELP router_sheds" in text
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert e.value.code == 404
+    finally:
+        router.stop()
+
+
+def test_multiprocess_router_real_sockets(world):
+    """Real OS processes, real sockets: stdlib-only clients hammer one
+    router concurrently and read byte-identical token payloads
+    (greedy determinism end to end through the HTTP plane)."""
+    cfg, params = world
+    router = RouterServer(_engines(params, cfg, 2),
+                          policy="prefix_affinity").start()
+    try:
+        outs = []
+        procs = []
+        for wid in range(2):
+            env = dict(os.environ)
+            env["ROUTER_URL"] = f"http://{router.host}:{router.port}"
+            env["ROUTER_WORKER_ID"] = str(wid)
+            procs.append(subprocess.Popen(
+                [sys.executable, ROUTER_WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for p in procs:
+            outs.append(p.communicate(timeout=180)[0])
+        payloads = []
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
+            assert "WORKER_OK" in out, f"worker {i} no OK line:\n{out}"
+            payloads.append(out.split("WORKER_OK ", 1)[1].splitlines()[0])
+        assert payloads[0] == payloads[1], (
+            "token payloads differ across workers:\n"
+            + "\n---\n".join(payloads))
+        tokens = json.loads(payloads[0])["results"][0]["tokens"]
+        want = _solo(params, cfg, list(range(2, 19)) + [40], 4)
+        np.testing.assert_array_equal(np.asarray(tokens, np.int64),
+                                      want.astype(np.int64))
+    finally:
+        router.stop()

@@ -156,6 +156,30 @@ def test_max_queue_steps_rejects(world):
     assert reject.step == 2 and reject.slot == -1
 
 
+def test_malformed_submit_rejects_without_raising(world):
+    """Empty prompt / zero budget are client-data errors, not caller
+    bugs: ``submit`` returns a rid whose result is already terminal
+    ``REJECTED`` (with a trace), so a router or HTTP front end gets a
+    status to forward instead of an exception to translate — and the
+    engine serves on, untouched."""
+    cfg, params = world
+    eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=4)
+    r1 = eng.submit(Request(prompt=[], max_new_tokens=4))
+    r2 = eng.submit(Request(prompt=[7, 8], max_new_tokens=0))
+    for rid in (r1, r2):
+        res = eng.results[rid]
+        assert res.status == REJECTED and list(res) == []
+        assert res.trace is not None
+        assert res.trace.rid == rid
+        assert res.trace.status == REJECTED
+    assert eng.counters["rejections"] == 2
+    assert not eng.pending()                 # nothing left enqueued
+    req = Request(prompt=[5, 17, 42], max_new_tokens=4)
+    out = eng.run([req])[0]
+    assert out.status == OK
+    _assert_solo_prefix(params, cfg, req, out, 16)
+
+
 def test_cancel_in_every_state(world):
     cfg, params = world
     eng = ServeEngine(params, cfg, n_slots=1, max_len=32, chunk=4)

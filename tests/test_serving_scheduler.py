@@ -28,7 +28,7 @@ import pytest
 
 from horovod_tpu import timeline as timeline_mod
 from horovod_tpu.models import llama
-from horovod_tpu.serving import Request
+from horovod_tpu.serving import REJECTED, Request
 from horovod_tpu.serving_scheduler import ServeEngine, measure_throughput
 
 
@@ -205,10 +205,12 @@ def test_submit_validation(world):
     cfg, params = world
     eng = ServeEngine(params, cfg, n_slots=1, max_len=16, chunk=6,
                       block_size=4)
-    with pytest.raises(ValueError, match="empty prompt"):
-        eng.submit(Request(prompt=[], max_new_tokens=2))
-    with pytest.raises(ValueError, match="max_new_tokens"):
-        eng.submit(Request(prompt=[1], max_new_tokens=0))
+    # Malformed-but-harmless requests REJECT instead of raising — a
+    # router/HTTP client sees a terminal status, not a torn connection.
+    rid = eng.submit(Request(prompt=[], max_new_tokens=2))
+    assert eng.results[rid].status == REJECTED
+    rid = eng.submit(Request(prompt=[1], max_new_tokens=0))
+    assert eng.results[rid].status == REJECTED
     with pytest.raises(ValueError, match="greedy-only"):
         eng.submit(Request(prompt=[1], max_new_tokens=2,
                            temperature=0.7))

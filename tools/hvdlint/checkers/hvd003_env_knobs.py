@@ -30,7 +30,7 @@ from tools.hvdlint.core import Checker, Finding, Project, register
 
 _KNOB_RE = re.compile(r"^(?:HVD_TPU|HOROVOD)_[A-Z0-9_]+$")
 _HELPERS = {"_get_int", "_get_float", "_get_bool", "_get_tristate",
-            "_env_float"}
+            "_env_float", "env_float", "_env_int"}
 _DOCS_ROW_RE = re.compile(r"^\|\s*`([A-Z0-9_]+)`\s*\|")
 
 

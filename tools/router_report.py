@@ -1,0 +1,240 @@
+"""Render a RouterServer fleet report in the terminal.
+
+The router publishes the same routing story two ways; this tool reads
+either one and renders identical tables:
+
+    python tools/router_report.py http://127.0.0.1:9500     # live /snapshot
+    python tools/router_report.py events.jsonl              # event-log replay
+    python tools/router_report.py snapshot.json [--json]    # saved snapshot
+
+A URL is scraped at its ``/snapshot`` endpoint (appended when missing)
+— the structured-JSON twin of ``/metrics`` whose ``replicas`` key
+carries the per-replica detail label-less Prometheus names can't; a
+``.jsonl`` source replays the ``router.route`` / ``router.shed`` /
+``router.failover`` / ``router.replica_death`` records of the
+structured event log (so a crashed router's story is still
+renderable); anything else is a saved ``/snapshot`` body or a prior
+``--json`` dump of this tool.
+
+Rendered: fleet totals (requests / sheds / failovers / deaths),
+per-replica routed + failover-arrival counts, and the affinity
+hit-length histogram (how many prompt tokens the prefix_affinity
+policy matched per placement — the routing-quality signal).  The
+replay path additionally breaks sheds down by reason and failovers by
+source replica, which the counter snapshot cannot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+_EVENT_KINDS = ("router.route", "router.shed", "router.failover",
+                "router.replica_death")
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Exact q-quantile (nearest-rank) of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def fetch_snapshot(url: str) -> dict:
+    """Scrape a live router's ``/snapshot`` endpoint."""
+    if not url.rstrip("/").endswith("/snapshot"):
+        url = url.rstrip("/") + "/snapshot"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def report_from_snapshot(snap: dict) -> dict:
+    """Shape a ``/snapshot`` body (counters/gauges/histograms plus the
+    ``replicas`` list) into the report schema."""
+    c = snap.get("counters", {})
+    routed_by_policy = {
+        name.split("router.routed.", 1)[1]: v
+        for name, v in c.items()
+        if name.startswith("router.routed.") and v}
+    replicas = []
+    for r in snap.get("replicas", []):
+        replicas.append({
+            "name": r.get("name"),
+            "routed": r.get("routed", 0),
+            "healthy": r.get("healthy"),
+            "inflight": r.get("inflight", 0),
+        })
+    return {
+        "source": "snapshot",
+        "requests": c.get("router.requests", 0),
+        "sheds": c.get("router.sheds", 0),
+        "failovers": c.get("router.failovers", 0),
+        "replica_deaths": c.get("router.replica_deaths", 0),
+        "affinity_fallbacks": c.get("router.affinity_fallbacks", 0),
+        "routed_by_policy": routed_by_policy,
+        "replicas": replicas,
+        "affinity": snap.get("histograms", {}).get(
+            "router.affinity_hit_tokens", {}),
+    }
+
+
+def report_from_events(events: list[dict]) -> dict:
+    """Rebuild the report from event-log records — the replay path.
+
+    Richer than the counter snapshot: sheds come back with their
+    reasons, failovers with their source replica, and the affinity
+    histogram is exact (every placement's hit length is in the log).
+    """
+    per: dict[str, dict] = {}
+
+    def row(name: str) -> dict:
+        return per.setdefault(name, {
+            "name": name, "routed": 0, "failover_arrivals": 0,
+            "failover_departures": 0, "died": False})
+
+    routed_by_policy: dict[str, int] = {}
+    sheds_by_reason: dict[str, int] = {}
+    hits: list[float] = []
+    requests = sheds = failovers = deaths = fallbacks = 0
+    for e in events:
+        kind = e.get("kind")
+        if kind not in _EVENT_KINDS:
+            continue
+        if kind == "router.route":
+            requests += 1
+            row(e["replica"])["routed"] += 1
+            pol = e.get("policy", "?")
+            routed_by_policy[pol] = routed_by_policy.get(pol, 0) + 1
+        elif kind == "router.shed":
+            requests += 1
+            sheds += 1
+            reason = e.get("reason", "?")
+            sheds_by_reason[reason] = sheds_by_reason.get(reason, 0) + 1
+        elif kind == "router.failover":
+            failovers += 1
+            row(e["dst"])["failover_arrivals"] += 1
+            row(e["src"])["failover_departures"] += 1
+        elif kind == "router.replica_death":
+            deaths += 1
+            row(e["replica"])["died"] = True
+        if "affinity_hit_tokens" in e:
+            hits.append(float(e["affinity_hit_tokens"]))
+            if e.get("fallback"):
+                fallbacks += 1
+    hits.sort()
+    affinity = {}
+    if hits:
+        affinity = {
+            "count": len(hits), "sum": sum(hits),
+            "min": hits[0], "max": hits[-1],
+            "p50": _percentile(hits, 0.50),
+            "p90": _percentile(hits, 0.90),
+            "p99": _percentile(hits, 0.99),
+        }
+    return {
+        "source": "events",
+        "requests": requests,
+        "sheds": sheds,
+        "failovers": failovers,
+        "replica_deaths": deaths,
+        "affinity_fallbacks": fallbacks,
+        "routed_by_policy": routed_by_policy,
+        "sheds_by_reason": sheds_by_reason,
+        "replicas": [per[n] for n in sorted(per)],
+        "affinity": affinity,
+    }
+
+
+def load_report(source: str) -> dict:
+    """Dispatch on the source shape: URL, event-log JSONL, or JSON
+    (a saved ``/snapshot`` body or a prior ``--json`` report)."""
+    if source.startswith(("http://", "https://")):
+        return report_from_snapshot(fetch_snapshot(source))
+    if source.endswith(".jsonl"):
+        events = []
+        with open(source) as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass          # torn tail line of a live/crashed log
+        return report_from_events(events)
+    with open(source) as f:
+        data = json.load(f)
+    if "routed_by_policy" in data:      # a prior --json dump
+        return data
+    if "counters" in data:              # a saved /snapshot body
+        return report_from_snapshot(data)
+    raise SystemExit(f"{source}: neither a router snapshot nor a "
+                     f"router report")
+
+
+def render(report: dict) -> str:
+    """Fleet totals, the per-replica table, and the affinity summary."""
+    lines = [
+        f"router report ({report.get('source', '?')}): "
+        f"{report.get('requests', 0)} requests, "
+        f"{report.get('sheds', 0)} shed, "
+        f"{report.get('failovers', 0)} failovers, "
+        f"{report.get('replica_deaths', 0)} replica deaths",
+    ]
+    pol = report.get("routed_by_policy", {})
+    if pol:
+        routed = ", ".join(f"{k}={v}" for k, v in sorted(pol.items()))
+        lines.append(f"routed by policy: {routed}")
+    reasons = report.get("sheds_by_reason", {})
+    if reasons:
+        shed = ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+        lines.append(f"sheds by reason: {shed}")
+
+    replicas = report.get("replicas", [])
+    if replicas:
+        lines.append(f"{'replica':16s} {'routed':>7s} {'fo in':>6s} "
+                     f"{'fo out':>7s} {'state':>8s}")
+        for r in replicas:
+            if "healthy" in r:
+                state = "healthy" if r["healthy"] else "dead"
+            else:
+                state = "dead" if r.get("died") else "?"
+            lines.append(
+                f"{str(r.get('name')):16s} {r.get('routed', 0):7d} "
+                f"{r.get('failover_arrivals', 0):6d} "
+                f"{r.get('failover_departures', 0):7d} {state:>8s}")
+
+    a = report.get("affinity", {})
+    if a.get("count"):
+        lines.append(
+            f"affinity hit tokens: n={a['count']} "
+            f"mean={a['sum'] / a['count']:.1f} min={a['min']:.0f} "
+            f"p50={a['p50']:.0f} p90={a['p90']:.0f} "
+            f"p99={a['p99']:.0f} max={a['max']:.0f} "
+            f"(fallbacks={report.get('affinity_fallbacks', 0)})")
+    else:
+        lines.append("affinity hit tokens: no placements recorded")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source",
+                    help="router URL, event-log .jsonl, or snapshot JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the report as JSON instead of tables")
+    args = ap.parse_args(argv)
+
+    report = load_report(args.source)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    if not report.get("requests") and not report.get("replicas"):
+        print("no router activity in source")
+        return 1
+    print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
