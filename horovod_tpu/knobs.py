@@ -86,6 +86,8 @@ ENV_KNOBS = (
      "Raise when the retrace sentry sees a jit cache grow mid-serve."),
     ("HVD_TPU_ROUTER_IMBALANCE", "4",
      "Inflight gap above which prefix_affinity falls back to least_loaded."),
+    ("HVD_TPU_ROUTER_MAX_FAILOVERS", "3",
+     "Failover replays allowed per request before it fails terminally."),
     ("HVD_TPU_ROUTER_MIN_FREE_KV", "0",
      "Fleet free-KV fraction floor below which the router sheds (0 = off)."),
     ("HVD_TPU_ROUTER_MIN_GOODPUT", "0",
@@ -96,6 +98,10 @@ ENV_KNOBS = (
      "Seconds between router polls of replica health and snapshots."),
     ("HVD_TPU_ROUTER_PORT", "",
      "Port for the RouterServer HTTP front door (maybe_start_router)."),
+    ("HVD_TPU_ROUTER_PROBE_FAILS", "3",
+     "Consecutive failed probes before an HTTP replica is marked dead."),
+    ("HVD_TPU_ROUTER_TICKET_TTL_S", "600",
+     "Seconds a finished router ticket stays readable before reaping."),
     ("HVD_TPU_SCHED_POLICY", "fifo",
      "ServeEngine scheduler policy: fifo, priority, or edf."),
     ("HVD_TPU_SLO_E2E_S", "0",

@@ -197,6 +197,7 @@ METRIC_HELP: dict[str, str] = {
     "router.sheds": "Requests REJECTED by router admission control (goodput / free-KV floors)",
     "router.failovers": "In-flight requests re-enqueued to survivors after a replica loss",
     "router.replica_deaths": "Replica healthy-to-dead transitions observed by the router",
+    "router.replica_revives": "Dead HTTP replicas returned to routing after healthy probes",
     "router.replicas_healthy": "Replicas currently accepting routed requests",
     "router.inflight": "Routed requests not yet terminal, fleet-wide",
     "router.shadow_index_bytes": "Approximate host bytes of the per-replica shadow prefix indexes",

@@ -140,6 +140,7 @@ class RadixPrefixCache:
         self.stats = {"hits": 0, "misses": 0, "blocks_reused": 0,
                       "tokens_skipped": 0, "inserted_blocks": 0,
                       "evicted_blocks": 0}
+        self._digest_cache: dict | None = None
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -175,7 +176,30 @@ class RadixPrefixCache:
         the deepest digest present here; no token ever leaves the
         replica.  Cost is one ``blake2b.copy()`` + one chunk hash per
         emitted path, so the summary is cheap enough to ride every
-        ``metrics_snapshot()``."""
+        ``metrics_snapshot()``.
+
+        The monitor serves ``/snapshot`` from its own HTTP thread while
+        the engine thread inserts/evicts nodes, so a scrape can land
+        mid-mutation and the walk can see a ``children`` dict change
+        size under it.  The walk retries on that ``RuntimeError`` and,
+        if the tree never holds still, falls back to the last complete
+        summary — staleness is benign for routing (one suboptimal
+        placement), a crashed scrape is not."""
+        for _ in range(4):
+            try:
+                summary = self._key_digest_walk(max_paths)
+            except RuntimeError:        # tree mutated mid-walk
+                continue
+            self._digest_cache = summary
+            return summary
+        stale = self._digest_cache
+        if stale is not None:
+            return dict(stale)
+        return {"block_size": self.block_size,
+                "indexed_blocks": len(self._nodes), "n_paths": 0,
+                "truncated": len(self._nodes) > 0, "paths": []}
+
+    def _key_digest_walk(self, max_paths: int) -> dict:
         paths: list[str] = []
         base = hashlib.blake2b(digest_size=8)
         q: "collections.deque[tuple[RadixNode, hashlib._Hash]]" = \

@@ -36,13 +36,18 @@ per request, *which* replica serves it:
   one field no matter which layer said no.
 
 * **Failover by replay.**  A replica death (the ``serve.router``
-  fault site in the :class:`LocalReplica` pump, a 503/connection error
-  for HTTP replicas) marks it dead and re-enqueues its in-flight
-  requests to survivors from the full original prompt.  Greedy decode
-  is deterministic (scheduler invariant 2, PR 2), so the failed-over
+  fault site in the :class:`LocalReplica` pump, repeated probe
+  failures for HTTP replicas — ``HVD_TPU_ROUTER_PROBE_FAILS``
+  consecutive, and an HTTP replica rejoins when probes turn healthy
+  again) marks it dead and re-enqueues its in-flight requests to
+  survivors from the full original prompt.  Greedy decode is
+  deterministic (scheduler invariant 2, PR 2), so the failed-over
   output is **bit-identical** to an uninterrupted run — mid-stream
   replica loss is invisible in the tokens, visible only in
-  ``router.failovers``.
+  ``router.failovers``.  Replays per request are capped
+  (``HVD_TPU_ROUTER_MAX_FAILOVERS``): a poison request that kills
+  every pump it touches fails terminally instead of walking the whole
+  fleet dead.
 
 Everything is host-side bookkeeping: the router never allocates device
 memory, never adds a jit signature, and works against replicas it can
@@ -297,6 +302,11 @@ class ReplicaHandle:
 
     name = "replica"
     block_size = 0      # 0 = unknown / no prefix cache
+    #: Whether a dead replica may rejoin routing when probes turn
+    #: healthy again.  False for in-process replicas (a dead pump
+    #: thread never comes back); True for HTTP replicas (the remote
+    #: process can restart, or the probe failure was transient).
+    can_revive = False
 
     def submit(self, req: Request, done_cb: DoneCallback) -> None:
         raise NotImplementedError
@@ -400,10 +410,11 @@ class LocalReplica(ReplicaHandle):
             for k, (req, cb) in enumerate(batch):
                 try:
                     rid = eng.submit(req)
-                except ValueError as e:
-                    # Engine-side programming/config validation: surface
-                    # as a terminal REJECTED rather than killing a
-                    # well-behaved fleet over one bad request.
+                except (TypeError, ValueError) as e:
+                    # Engine-side validation, including TypeError from
+                    # lifecycle-field arithmetic on a malformed request:
+                    # surface as a terminal REJECTED rather than killing
+                    # a well-behaved fleet over one bad request.
                     cb(RequestResult([], REJECTED, e))
                     continue
                 except BaseException:
@@ -468,7 +479,14 @@ class HttpReplica(ReplicaHandle):
     Each submission runs in a short-lived daemon thread so the router
     never blocks on the network; a connection error or non-2xx reply
     fires the callback with ``None`` — the same failover signal a
-    local pump death produces."""
+    local pump death produces.  A socket *timeout* is different: the
+    backend may be slow but alive and still decoding, so replaying
+    the request elsewhere would silently duplicate the work — it
+    terminates the request ``FAILED`` instead (and the per-request
+    wire budget stretches past ``deadline_s`` when one is set, so an
+    engine-side ``TIMEOUT`` always beats the socket to it)."""
+
+    can_revive = True
 
     def __init__(self, name: str, generate_url: str,
                  monitor_url: str | None = None,
@@ -480,10 +498,22 @@ class HttpReplica(ReplicaHandle):
         self.block_size = block_size
         self.timeout_s = timeout_s
 
+    def _request_timeout_s(self, req: Request) -> float:
+        """Wire budget for one submission: a deadline-carrying request
+        gets its own deadline plus the configured margin, so the
+        backend's deadline-expiry reply (``TIMEOUT``, tokens-so-far)
+        always arrives before the socket gives up."""
+        if req.deadline_s is None:
+            return self.timeout_s
+        return max(self.timeout_s, req.deadline_s + self.timeout_s)
+
     def submit(self, req: Request, done_cb: DoneCallback) -> None:
         payload = request_to_json(req)
+        timeout_s = self._request_timeout_s(req)
 
         def _post() -> None:
+            import socket
+            import urllib.error
             import urllib.request
             try:
                 http_req = urllib.request.Request(
@@ -491,10 +521,19 @@ class HttpReplica(ReplicaHandle):
                     data=json.dumps(payload).encode(),
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(
-                        http_req, timeout=self.timeout_s) as resp:
+                        http_req, timeout=timeout_s) as resp:
                     body = json.loads(resp.read().decode())
                 done_cb(RequestResult(body.get("tokens", []),
                                       body.get("status", FAILED)))
+            except (TimeoutError, socket.timeout) as e:
+                # Slow-but-alive backend: fail, don't duplicate.
+                done_cb(RequestResult([], FAILED, e))
+            except urllib.error.URLError as e:
+                if isinstance(getattr(e, "reason", None),
+                              (TimeoutError, socket.timeout)):
+                    done_cb(RequestResult([], FAILED, e))
+                else:
+                    done_cb(None)   # refused / reset / non-2xx: failover
             except Exception:
                 done_cb(None)
 
@@ -548,9 +587,29 @@ def request_to_json(req: Request) -> dict:
             "priority": req.priority}
 
 
+def _opt_number(payload: dict, field: str) -> "float | None":
+    v = payload.get(field)
+    if v is not None and (isinstance(v, bool)
+                          or not isinstance(v, (int, float))):
+        raise ValueError(f"{field} must be a number or null")
+    return v
+
+
+def _opt_int(payload: dict, field: str) -> "int | None":
+    v = payload.get(field)
+    if v is not None and (isinstance(v, bool) or not isinstance(v, int)):
+        raise ValueError(f"{field} must be an int or null")
+    return v
+
+
 def request_from_json(payload: dict) -> Request:
     """Parse the wire form back; raises ``ValueError`` on junk (the
-    handler maps that to HTTP 400)."""
+    handler maps that to HTTP 400).  EVERY field is type-checked here
+    — the lifecycle fields too, not just prompt/budget: an unchecked
+    string ``deadline_s`` would only explode later, inside
+    ``ServeEngine.submit``/``step`` arithmetic on a pump thread, where
+    the router reads the crash as a replica death and replays the same
+    poisoned request onto each survivor in turn."""
     if not isinstance(payload, dict):
         raise ValueError("body must be a JSON object")
     prompt = payload.get("prompt")
@@ -561,11 +620,11 @@ def request_from_json(payload: dict) -> Request:
     if not isinstance(mnt, int):
         raise ValueError("max_new_tokens must be an int")
     return Request(prompt=prompt, max_new_tokens=mnt,
-                   eos_id=payload.get("eos_id"),
-                   deadline_s=payload.get("deadline_s"),
-                   max_queue_steps=payload.get("max_queue_steps"),
-                   slo_s=payload.get("slo_s"),
-                   priority=int(payload.get("priority") or 0))
+                   eos_id=_opt_int(payload, "eos_id"),
+                   deadline_s=_opt_number(payload, "deadline_s"),
+                   max_queue_steps=_opt_int(payload, "max_queue_steps"),
+                   slo_s=_opt_number(payload, "slo_s"),
+                   priority=_opt_int(payload, "priority") or 0)
 
 
 # ---------------------------------------------------------------------------
@@ -580,7 +639,7 @@ class _Ticket:
     cross-thread wait point."""
 
     __slots__ = ("rid", "req", "replica", "shed", "failovers",
-                 "result", "done", "policy")
+                 "result", "done", "done_ts", "policy")
 
     def __init__(self, rid: int, req: Request):
         self.rid = rid
@@ -590,6 +649,7 @@ class _Ticket:
         self.failovers = 0
         self.result: RequestResult | None = None
         self.done = threading.Event()
+        self.done_ts = 0.0                  # monotonic, for TTL reaping
         self.policy = ""
 
 
@@ -679,7 +739,7 @@ class RouterServer:
     lock held, so the reverse edge never forms."""
 
     _GUARDED_BY_LOCK = ("_tickets", "_views", "_shadows", "_inflight",
-                        "_routed", "_dead", "_next_rid")
+                        "_routed", "_dead", "_probe_fails", "_next_rid")
 
     class _Server(ThreadingHTTPServer):
         daemon_threads = True
@@ -694,6 +754,9 @@ class RouterServer:
                  min_free_kv: float | None = None,
                  imbalance: float | None = None,
                  poll_s: float | None = None,
+                 max_failovers: int | None = None,
+                 probe_fails: int | None = None,
+                 ticket_ttl_s: float | None = None,
                  shadow_max_paths: int = 4096):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -719,10 +782,27 @@ class RouterServer:
                           env_float("HVD_TPU_ROUTER_IMBALANCE", 4.0))
         self.poll_s = (poll_s if poll_s is not None else
                        env_float("HVD_TPU_ROUTER_POLL_S", 0.05))
+        # Replays allowed per request before it fails terminally — the
+        # backstop that keeps a poison request (one that kills every
+        # pump it touches) from cascading through the whole fleet.
+        self.max_failovers = int(
+            max_failovers if max_failovers is not None else
+            env_float("HVD_TPU_ROUTER_MAX_FAILOVERS", 3))
+        # Consecutive failed probes before a revivable (HTTP) replica
+        # is marked dead; one blip or a still-starting backend must not
+        # permanently shrink the fleet.
+        self.probe_fails = max(1, int(
+            probe_fails if probe_fails is not None else
+            env_float("HVD_TPU_ROUTER_PROBE_FAILS", 3)))
+        self.ticket_ttl_s = (
+            ticket_ttl_s if ticket_ttl_s is not None else
+            env_float("HVD_TPU_ROUTER_TICKET_TTL_S", 600.0))
 
         self._lock = threading.Lock()
         self._next_rid = 0
         self._tickets: dict[int, _Ticket] = {}
+        self._probe_fails: dict[str, int] = {r.name: 0
+                                             for r in self.replicas}
         self._views: dict[str, dict] = {}
         self._shadows: dict[str, ShadowPrefixIndex] = {
             r.name: ShadowPrefixIndex(r.block_size, shadow_max_paths)
@@ -742,6 +822,7 @@ class RouterServer:
         self.metrics.counter("router.sheds")
         self.metrics.counter("router.failovers")
         self.metrics.counter("router.replica_deaths")
+        self.metrics.counter("router.replica_revives")
         self.metrics.counter("router.affinity_fallbacks")
         self.metrics.histogram("router.affinity_hit_tokens")
         self.metrics.gauge("router.replicas_healthy").set(
@@ -796,6 +877,9 @@ class RouterServer:
         """Admit-or-shed, choose a replica, submit.  Returns the router
         request id (poll :meth:`result`); a shed request gets a
         terminal ``REJECTED`` result immediately."""
+        return self._route(req).rid
+
+    def _route(self, req: Request) -> _Ticket:
         self.metrics.counter("router.requests").inc()
         with self._lock:
             rid = self._next_rid
@@ -805,12 +889,12 @@ class RouterServer:
             shed = self._admission_locked()
             if shed is not None:
                 self._shed_locked(ticket, shed)
-                return rid
+                return ticket
             handle, info = self._place_locked(ticket)
         self.metrics.event("router.route", rid=rid, replica=handle.name,
                            policy=ticket.policy, **info)
         handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
-        return rid
+        return ticket
 
     def result(self, rid: int,
                timeout: float | None = None) -> RequestResult | None:
@@ -824,24 +908,46 @@ class RouterServer:
             return None
         return ticket.result
 
+    def reap_tickets(self, older_than_s: float | None = None) -> int:
+        """Drop tickets whose terminal result has been readable for at
+        least ``older_than_s`` seconds (default ``ticket_ttl_s``);
+        returns how many were dropped.  The poller runs this every
+        pass and ``handle_generate`` pops its own ticket with the HTTP
+        reply, so the ticket table stays bounded under an indefinite
+        request stream.  Programmatic :meth:`route`/:meth:`result`
+        users must read a result within the TTL — :meth:`result`
+        raises ``KeyError`` for a reaped rid."""
+        ttl = self.ticket_ttl_s if older_than_s is None else older_than_s
+        now = time.monotonic()
+        with self._lock:
+            dead = [rid for rid, t in self._tickets.items()
+                    if t.done.is_set() and now - t.done_ts >= ttl]
+            for rid in dead:
+                del self._tickets[rid]
+        return len(dead)
+
     def handle_generate(self, req: Request) -> tuple[int, dict]:
         """The ``POST /v1/generate`` body: route, wait, and shape the
         JSON reply.  Shed requests answer 429 (back off and retry is
         the right client response to load shedding); every other
         terminal status is a 200 whose ``status`` field speaks."""
-        rid = self.route(req)
-        res = self.result(rid, timeout=None)
+        ticket = self._route(req)
         with self._lock:
-            ticket = self._tickets[rid]
-            body = {"rid": rid, "status": res.status,
-                    "tokens": list(res),
-                    "replica": ticket.replica,
-                    "failovers": ticket.failovers}
-            if ticket.shed is not None:
-                body["shed"] = ticket.shed
-            if res.error is not None:
-                body["error"] = str(res.error)
-            code = 429 if ticket.shed is not None else 200
+            # Claim the ticket immediately: the HTTP reply is its only
+            # reader, and a front door that never forgets a finished
+            # request leaks prompt+result tokens without bound.
+            self._tickets.pop(ticket.rid, None)
+        ticket.done.wait()
+        res = ticket.result
+        body = {"rid": ticket.rid, "status": res.status,
+                "tokens": list(res),
+                "replica": ticket.replica,
+                "failovers": ticket.failovers}
+        if ticket.shed is not None:
+            body["shed"] = ticket.shed
+        if res.error is not None:
+            body["error"] = str(res.error)
+        code = 429 if ticket.shed is not None else 200
         return code, body
 
     def _admission_locked(self) -> str | None:
@@ -870,6 +976,7 @@ class RouterServer:
         ticket.result = RequestResult([], REJECTED)
         self.metrics.counter("router.sheds").inc()
         self.metrics.event("router.shed", rid=ticket.rid, reason=reason)
+        ticket.done_ts = time.monotonic()
         ticket.done.set()
 
     def _place_locked(
@@ -921,6 +1028,7 @@ class RouterServer:
                     self._inflight[ticket.replica] = max(n - 1, 0)
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
+                ticket.done_ts = time.monotonic()
             ticket.done.set()
             return
         with self._lock:
@@ -930,12 +1038,22 @@ class RouterServer:
             if old is not None:
                 n = self._inflight.get(old, 1)
                 self._inflight[old] = max(n - 1, 0)
+            err: RuntimeError | None = None
             if all(r.name in self._dead for r in self.replicas):
-                ticket.result = RequestResult(
-                    [], FAILED,
-                    RuntimeError("no healthy replicas for failover"))
+                err = RuntimeError("no healthy replicas for failover")
+            elif ticket.failovers >= self.max_failovers:
+                # A request that kills every replica it lands on would
+                # otherwise walk the whole fleet dead; stop replaying
+                # after max_failovers and fail THIS request instead.
+                err = RuntimeError(
+                    f"request failed over {ticket.failovers} times "
+                    f"(max_failovers={self.max_failovers}); not "
+                    "replaying again")
+            if err is not None:
+                ticket.result = RequestResult([], FAILED, err)
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
+                ticket.done_ts = time.monotonic()
                 ticket.done.set()
                 return
             ticket.failovers += 1
@@ -959,23 +1077,54 @@ class RouterServer:
         self.metrics.gauge("router.replicas_healthy").set(healthy)
         self.metrics.event("router.replica_death", replica=name)
 
+    def _mark_alive(self, name: str) -> None:
+        """Return a revived replica to the candidate set (poll path
+        only, for ``can_revive`` handles whose probes turned healthy)."""
+        with self._lock:
+            if name not in self._dead:
+                return
+            self._dead.discard(name)
+            healthy = len(self.replicas) - len(self._dead)
+        self.metrics.counter("router.replica_revives").inc()
+        self.metrics.gauge("router.replicas_healthy").set(healthy)
+        self.metrics.event("router.replica_revive", replica=name)
+
     # -- polling + reports -------------------------------------------------
 
     def poll_now(self) -> None:
         """One synchronous poll pass (the poller thread's body; tests
-        and the bench call it directly for deterministic views)."""
+        and the bench call it directly for deterministic views).
+
+        Death is debounced for revivable replicas: an HTTP replica
+        needs ``probe_fails`` CONSECUTIVE failed probes before it
+        leaves the candidate set (one ``/healthz`` blip, or a backend
+        still starting at the first 0.05s poll, must not permanently
+        shrink the fleet), and a healthy probe brings it back.  A
+        local replica's probe is authoritative — its pump thread is
+        gone — so it dies on the first unhealthy view and stays dead."""
         for r in list(self.replicas):
             try:
                 view = r.probe()
             except Exception:
                 view = {"healthy": False}
+            healthy = bool(view.get("healthy", False))
             with self._lock:
                 self._views[r.name] = view
                 self._shadows[r.name].load(view.get("prefix"))
-            if not view.get("healthy", False):
-                self._mark_dead(r.name)      # no-op when already dead
+                if healthy:
+                    self._probe_fails[r.name] = 0
+                else:
+                    self._probe_fails[r.name] = \
+                        self._probe_fails.get(r.name, 0) + 1
+                fails = self._probe_fails[r.name]
+            if healthy:
+                if r.can_revive:
+                    self._mark_alive(r.name)  # no-op when not dead
+            elif not r.can_revive or fails >= self.probe_fails:
+                self._mark_dead(r.name)       # no-op when already dead
         self.metrics.gauge("router.shadow_index_bytes").set(
             self._shadow_bytes())
+        self.reap_tickets()
 
     def _poll_loop(self) -> None:
         while not self._poll_stop.wait(self.poll_s):

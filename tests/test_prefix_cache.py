@@ -26,7 +26,7 @@ import pytest
 from horovod_tpu.faults import FaultRegistry, PermanentFault
 from horovod_tpu.models import llama
 from horovod_tpu.models.llama import BlockPool
-from horovod_tpu.prefix_cache import RadixPrefixCache
+from horovod_tpu.prefix_cache import RadixPrefixCache, chunk_path_digests
 from horovod_tpu.serving import FAILED, OK, Request
 from horovod_tpu.serving_scheduler import (
     ServeEngine, measure_prefix_throughput,
@@ -153,6 +153,52 @@ def test_radix_evict_lru_leaf_first():
     assert cache.evict(1) == 0               # still referenced
     cache.release(blocks)
     assert cache.evict(1) == 1
+
+
+def test_key_digest_summary_and_concurrent_walk_fallback(monkeypatch):
+    """key_digest() is scraped from the monitor's HTTP thread while the
+    engine mutates the tree: a mid-walk mutation (RuntimeError) must
+    retry, then fall back to the last complete summary — never crash
+    the scrape."""
+    pool = BlockPool(8)
+    cache = RadixPrefixCache(pool, block_size=2)
+    toks = [5, 6, 7, 8]
+    blocks = [pool.alloc() for _ in range(2)]
+    for b in blocks:
+        pool.incref(b)
+    cache.insert(toks, blocks, frontier=4)
+    cache.release(reversed(blocks))
+    summary = cache.key_digest()
+    assert summary["block_size"] == 2 and summary["n_paths"] == 2
+    assert not summary["truncated"]
+    assert set(summary["paths"]) == set(chunk_path_digests(toks, 2))
+
+    # One mutation mid-walk: the retry succeeds transparently.
+    real_walk = cache._key_digest_walk
+    calls = {"n": 0}
+
+    def flaky(max_paths):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("dictionary changed size during iteration")
+        return real_walk(max_paths)
+
+    monkeypatch.setattr(cache, "_key_digest_walk", flaky)
+    assert cache.key_digest() == summary and calls["n"] == 2
+
+    # A tree that never holds still: serve the last complete summary.
+    def boom(max_paths):
+        raise RuntimeError("dictionary changed size during iteration")
+
+    monkeypatch.setattr(cache, "_key_digest_walk", boom)
+    assert cache.key_digest() == summary
+
+    # No complete walk ever: an empty-but-schema-stable summary.
+    cold = RadixPrefixCache(BlockPool(4), block_size=2)
+    monkeypatch.setattr(cold, "_key_digest_walk", boom)
+    empty = cold.key_digest()
+    assert empty["n_paths"] == 0 and empty["paths"] == []
+    assert not empty["truncated"]
 
 
 # -- engine integration ------------------------------------------------------

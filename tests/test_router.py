@@ -20,10 +20,13 @@ sleeps in any assertion path:
 
 from __future__ import annotations
 
+import http.server
 import json
 import os
 import subprocess
 import sys
+import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -36,11 +39,12 @@ from horovod_tpu.faults import FaultRegistry
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import chunk_path_digests
 from horovod_tpu.router import (
-    LeastLoadedPolicy, PrefixAffinityPolicy, RoundRobinPolicy,
-    RouterServer, RoutingContext, ShadowPrefixIndex, request_from_json,
-    request_to_json, resolve_routing_policy,
+    HttpReplica, LeastLoadedPolicy, PrefixAffinityPolicy, ReplicaHandle,
+    RoundRobinPolicy, RouterServer, RoutingContext, ShadowPrefixIndex,
+    request_from_json, request_to_json, resolve_routing_policy,
 )
-from horovod_tpu.serving import FAILED, OK, REJECTED, Request
+from horovod_tpu.serving import (FAILED, OK, REJECTED, Request,
+                                 RequestResult)
 from horovod_tpu.serving_scheduler import ServeEngine
 
 pytestmark = pytest.mark.router
@@ -175,6 +179,26 @@ def test_request_json_roundtrip():
                               "priority": None}).priority == 0
 
 
+def test_request_json_lifecycle_field_validation():
+    """Every optional lifecycle field is type-checked at the door: junk
+    must be a ValueError (HTTP 400) HERE, not a TypeError later inside
+    a replica pump's submit/step arithmetic — where the router would
+    read the crash as a replica death and replay the poisoned request
+    onto each survivor in turn."""
+    ok = request_from_json({"prompt": [1], "max_new_tokens": 2,
+                            "deadline_s": 1.5, "slo_s": 2,
+                            "max_queue_steps": 3, "eos_id": 7})
+    assert ok.deadline_s == 1.5 and ok.slo_s == 2
+    assert ok.max_queue_steps == 3 and ok.eos_id == 7
+    for field, junk in [("deadline_s", "soon"), ("deadline_s", True),
+                        ("slo_s", [1]), ("max_queue_steps", 2.5),
+                        ("max_queue_steps", "many"), ("eos_id", "eos"),
+                        ("priority", "high")]:
+        with pytest.raises(ValueError, match=field):
+            request_from_json({"prompt": [1], "max_new_tokens": 2,
+                               field: junk})
+
+
 # -- routing through real engines --------------------------------------------
 
 
@@ -285,6 +309,195 @@ def test_failover_outputs_bit_identical(world):
     finally:
         router.stop()
         fr.clear()
+
+
+# -- hardening: poison requests, ticket hygiene, probe debounce --------------
+
+
+class _EchoReplica(ReplicaHandle):
+    """Completes every submission instantly with OK(prompt) — a replica
+    with no engine behind it, for router-bookkeeping tests."""
+
+    def __init__(self, name: str = "echo"):
+        self.name = name
+
+    def submit(self, req, done_cb):
+        done_cb(RequestResult(list(req.prompt), OK))
+
+    def probe(self):
+        return {"healthy": True, "inflight": 0, "queue_depth": 0,
+                "goodput": 1.0, "free_kv_frac": 1.0, "prefix": None}
+
+
+class _CrashingReplica(_EchoReplica):
+    """Signals death-in-flight (the ``None`` failover signal) for every
+    submission while always probing healthy — the worst case of a
+    poison request that kills whatever pump it lands on."""
+
+    def submit(self, req, done_cb):
+        done_cb(None)
+
+
+def test_malformed_lifecycle_request_rejected_not_fatal(world):
+    """A programmatic caller can hand the router a Request whose
+    deadline_s is a string (bypassing request_from_json); the engine's
+    submit-side arithmetic raises TypeError, which the pump maps to a
+    terminal REJECTED — not a replica death followed by a poison
+    replay across the fleet."""
+    cfg, params = world
+    router = RouterServer(_engines(params, cfg, 1),
+                          policy="round_robin")
+    try:
+        rid = router.route(Request(prompt=[2, 3], max_new_tokens=2,
+                                   deadline_s="soon"))
+        res = router.result(rid, timeout=30)
+        assert res.status == REJECTED
+        snap = router.metrics.snapshot()
+        assert snap["counters"]["router.replica_deaths"] == 0
+        assert snap["counters"]["router.failovers"] == 0
+        # The replica survived and still serves.
+        rid = router.route(Request(prompt=[2, 3], max_new_tokens=2))
+        assert router.result(rid, timeout=60).status == OK
+    finally:
+        router.stop()
+
+
+def test_failover_cap_stops_poison_cascade():
+    """A request that kills every replica it lands on is replayed at
+    most max_failovers times, then fails terminally — it must not
+    bounce around the fleet forever."""
+    router = RouterServer(
+        [_CrashingReplica("a"), _CrashingReplica("b")],
+        policy="round_robin", max_failovers=3)
+    try:
+        rid = router.route(Request(prompt=[1, 2], max_new_tokens=2))
+        res = router.result(rid, timeout=10)
+        assert res.status == FAILED
+        assert "failed over 3 times" in str(res.error)
+        snap = router.metrics.snapshot()
+        assert snap["counters"]["router.failovers"] == 3
+        assert snap["gauges"]["router.inflight"] == 0
+    finally:
+        router.stop()
+
+
+def test_ticket_reaping_bounds_the_table():
+    router = RouterServer([_EchoReplica()], policy="round_robin",
+                          ticket_ttl_s=0.0)
+    try:
+        code, body = router.handle_generate(
+            Request(prompt=[4, 2], max_new_tokens=1))
+        assert code == 200 and body["tokens"] == [4, 2]
+        # The HTTP reply is a ticket's last reader: popped with it.
+        assert router.memory_report()["tickets"] == 0
+        rid = router.route(Request(prompt=[7], max_new_tokens=1))
+        assert router.result(rid, timeout=10).status == OK
+        assert router.memory_report()["tickets"] == 1
+        router.poll_now()       # the poller reaps done tickets past TTL
+        assert router.memory_report()["tickets"] == 0
+        with pytest.raises(KeyError, match="unknown router rid"):
+            router.result(rid)
+    finally:
+        router.stop()
+
+
+def test_probe_debounce_and_http_revival():
+    """An HTTP-style (can_revive) replica needs probe_fails CONSECUTIVE
+    failed probes to leave the candidate set — one blip must not
+    permanently shrink the fleet — and healthy probes bring it back."""
+
+    class _Flaky(_EchoReplica):
+        can_revive = True
+        healthy = True
+
+        def probe(self):
+            return dict(super().probe(), healthy=self.healthy)
+
+    flaky = _Flaky("flaky")
+    router = RouterServer([flaky, _EchoReplica()],
+                          policy="round_robin", probe_fails=3)
+    try:
+        def healthy_gauge():
+            return router.metrics.snapshot()["gauges"][
+                "router.replicas_healthy"]
+
+        flaky.healthy = False
+        router.poll_now()
+        router.poll_now()
+        assert healthy_gauge() == 2         # two blips: still routable
+        flaky.healthy = True
+        router.poll_now()                   # healthy probe resets count
+        flaky.healthy = False
+        router.poll_now()
+        router.poll_now()
+        assert healthy_gauge() == 2
+        router.poll_now()                   # third consecutive: dead
+        assert healthy_gauge() == 1
+        report = {r["name"]: r for r in router.replicas_report()}
+        assert not report["flaky"]["healthy"]
+        flaky.healthy = True
+        router.poll_now()                   # HTTP replicas rejoin
+        assert healthy_gauge() == 2
+        snap = router.metrics.snapshot()
+        assert snap["counters"]["router.replica_deaths"] == 1
+        assert snap["counters"]["router.replica_revives"] == 1
+    finally:
+        router.stop()
+
+
+def test_http_replica_timeout_is_terminal_not_failover():
+    """A socket timeout means slow-but-alive: the submission must fail
+    terminally rather than fire the None failover signal (replaying
+    elsewhere would silently run the decode twice).  A refused
+    connection is a dead backend and still signals failover."""
+
+    class _Slow(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            time.sleep(0.8)
+            try:
+                body = b'{"tokens": [], "status": "OK"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception:
+                pass                        # client already gave up
+
+        def log_message(self, fmt, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Slow)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        slow = HttpReplica(
+            "slow", f"http://127.0.0.1:{srv.server_address[1]}",
+            timeout_s=0.2)
+        got: list = []
+        ev = threading.Event()
+        slow.submit(Request(prompt=[1], max_new_tokens=1),
+                    lambda r: (got.append(r), ev.set()))
+        assert ev.wait(10)
+        assert got[0] is not None and got[0].status == FAILED
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    refused = HttpReplica("refused", "http://127.0.0.1:9",
+                          timeout_s=0.5)
+    got2: list = []
+    ev2 = threading.Event()
+    refused.submit(Request(prompt=[1], max_new_tokens=1),
+                   lambda r: (got2.append(r), ev2.set()))
+    assert ev2.wait(10)
+    assert got2[0] is None
+
+    # Deadline-carrying requests stretch the wire budget past their own
+    # deadline, so an engine-side TIMEOUT reply beats the socket.
+    rep = HttpReplica("r", "http://example.invalid", timeout_s=30.0)
+    assert rep._request_timeout_s(
+        Request(prompt=[1], max_new_tokens=1)) == 30.0
+    assert rep._request_timeout_s(
+        Request(prompt=[1], max_new_tokens=1, deadline_s=45.0)) == 75.0
 
 
 def test_memory_report_counts_shadow_indexes(world):
