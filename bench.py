@@ -787,6 +787,54 @@ def _bench_serve_router(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_chaos(hvd, on_tpu: bool) -> dict:
+    """Self-healing arm (extras, TPU only): a seeded fault storm —
+    engine faults at every storm site plus one replica kill — against
+    a supervised 3-replica fleet, reporting goodput retention versus
+    the fault-free run (the fault-free fleet completes everything, so
+    the OK fraction IS retention).  The recovery-invariant oracles
+    (bit-identical OK outputs, zero leaked tickets/blocks, every fault
+    logged, fleet healed) gate the arm: ``serve_chaos_oracles_ok``
+    must stay True (acceptance bar), and the dashboard watches
+    ``serve_chaos_goodput_retention`` for regressions in how much
+    work a storm costs."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.chaos import measure_chaos_goodput
+    from horovod_tpu.models import llama
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        kw = dict(n_replicas=3, n_groups=4, waves=3)
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        kw = dict(n_replicas=3, n_groups=4, waves=6, n_slots=4,
+                  max_len=256, chunk=32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    r = measure_chaos_goodput(params, cfg, seed=0, **kw)
+    return {
+        "serve_chaos_goodput_retention": round(
+            r["serve_chaos_goodput_retention"], 3),
+        "serve_chaos_ok_fraction": round(
+            r["serve_chaos_ok_fraction"], 3),
+        "serve_chaos_faults_fired": r["serve_chaos_faults_fired"],
+        "serve_chaos_kills_fired": r["serve_chaos_kills_fired"],
+        "serve_chaos_respawns": r["serve_chaos_respawns"],
+        "serve_chaos_oracles_ok": r["serve_chaos_oracles_ok"],
+        "serve_chaos_shape": (
+            f"r{kw['n_replicas']}_g{kw['n_groups']}_w{kw['waves']}_"
+            f"seed0"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1292,6 +1340,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     for fn in (_bench_fusion, _bench_serving,
                _bench_serving_overcommit, _bench_serve_prefix,
                _bench_serve_spec, _bench_serve_router,
+               _bench_serve_chaos,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):

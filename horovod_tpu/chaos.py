@@ -1,0 +1,381 @@
+"""Deterministic chaos campaigns for the serving fleet.
+
+The :mod:`~horovod_tpu.faults` registry made single faults
+reproducible; this module makes *storms* reproducible.  A
+:class:`ChaosSchedule` is a pure function of its seed — a set of
+step-counted fault rules over the registry's named sites plus
+replica-kill events — so a failing campaign is a one-integer bug
+report: same seed, same workload → same faults, same recovery, same
+bits.  No wall clock enters the schedule (kills and faults fire on hit
+*counts*, the registry's own determinism contract); wall clock only
+bounds the overall campaign.
+
+:func:`run_campaign` drives one seeded storm against a live
+router+supervisor fleet serving a canned workload, then checks the
+**invariant oracles** that define "self-healing" for this codebase:
+
+* ``bit_identical`` — every chaos-run request that terminated ``OK``
+  produced exactly the fault-free reference tokens (greedy determinism
+  must survive retry, failover, respawn, and journal replay).
+* ``no_leaked_tickets`` — the router's ticket table is empty once
+  every result is read and reaped: a storm must not strand bookkeeping.
+* ``no_leaked_blocks`` — every surviving engine passes
+  ``prefix.check_consistency()`` and every KV block is free or cached
+  (reference counts drained to zero).
+* ``metrics_monotonic`` — counters sampled across the campaign never
+  decrease (a storm must not corrupt the observability plane).
+* ``faults_logged`` — every fault the registry fired appears as a
+  ``"fault"`` event in the structured event log: if chaos is
+  invisible, postmortems are fiction.
+* ``healed`` — after the storm, every replica a kill took down is
+  routable again (the supervisor respawned it within its budget).
+
+:func:`soak` repeats campaigns with consecutive seeds until a
+wall-clock budget runs out (the long-haul mode); :func:`compare_campaigns`
+is the JSON regression gate (the ``profile_report.py --compare``
+contract: exit nonzero when recovery got worse).  The CLI lives in
+``tools/chaos_run.py``; the bench arm
+(:func:`measure_chaos_goodput`) reports goodput retention under a
+canned storm versus the fault-free fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import tempfile
+import time
+from typing import Any, Sequence
+
+from horovod_tpu import faults as faults_mod
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.router import RouterServer
+from horovod_tpu.serving import OK, Request
+from horovod_tpu.supervisor import ReplicaSupervisor
+
+#: Engine-internal sites a storm may hit freely: each is covered by a
+#: recovery path (bounded retry, admission quarantine, cache
+#: quarantine), so a firing rule must never corrupt *other* requests.
+STORM_SITES = ("serve.prefill", "serve.tick", "serve.admit",
+               "serve.cache")
+
+#: The replica-kill site (the LocalReplica pump; key = replica name).
+KILL_SITE = "serve.router"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosRule:
+    """One scheduled fault, in registry terms (see
+    :meth:`~horovod_tpu.faults.FaultRegistry.inject`)."""
+
+    site: str
+    on_hit: int
+    count: int = 1
+    key: Any = None
+
+    def arm(self, fr: faults_mod.FaultRegistry) -> faults_mod.FaultRule:
+        return fr.inject(self.site, on_hit=self.on_hit,
+                         count=self.count, key=self.key)
+
+
+class ChaosSchedule:
+    """A seed-deterministic storm: engine-site fault rules plus
+    replica kills.  ``generate`` guarantees site *coverage* — the
+    first ``len(sites)`` rules cycle every storm site once, so any
+    ``n_faults >= len(sites)`` exercises at least that many distinct
+    sites — then spreads the rest randomly.  Kills are transient
+    single-shot rules on the pump site keyed by replica name: the pump
+    dies once at the scheduled hit, and the respawned replica's pump
+    advances the same counter past the window instead of re-dying
+    forever.  Kill hit windows are kept early (``kill_max_hit``): the
+    pump's site-hit count tracks engine steps, which drift slightly
+    with inbox batching, so a late window might never be reached —
+    an early one always is."""
+
+    def __init__(self, seed: int, rules: Sequence[ChaosRule],
+                 kills: Sequence[ChaosRule]):
+        self.seed = seed
+        self.rules = tuple(rules)
+        self.kills = tuple(kills)
+
+    @staticmethod
+    def generate(seed: int, *,
+                 replica_names: Sequence[str],
+                 sites: Sequence[str] = STORM_SITES,
+                 n_faults: int = 6,
+                 n_kills: int = 1,
+                 max_hit: int = 12,
+                 kill_min_hit: int = 2,
+                 kill_max_hit: int = 8) -> "ChaosSchedule":
+        rng = random.Random(seed)
+        rules = []
+        for i in range(n_faults):
+            site = (sites[i % len(sites)] if i < len(sites)
+                    else rng.choice(sites))
+            rules.append(ChaosRule(site=site,
+                                   on_hit=rng.randint(1, max_hit),
+                                   count=rng.randint(1, 2)))
+        kills = [ChaosRule(site=KILL_SITE,
+                           on_hit=rng.randint(kill_min_hit,
+                                              kill_max_hit),
+                           key=rng.choice(list(replica_names)))
+                 for _ in range(n_kills)]
+        return ChaosSchedule(seed, rules, kills)
+
+    def arm(self, fr: faults_mod.FaultRegistry) -> None:
+        for rule in self.rules + self.kills:
+            rule.arm(fr)
+
+    def sites(self) -> list[str]:
+        return sorted({r.site for r in self.rules}
+                      | {k.site for k in self.kills})
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "rules": [dataclasses.asdict(r) for r in self.rules],
+                "kills": [dataclasses.asdict(k) for k in self.kills]}
+
+
+def _workload(n_groups: int, waves: int, *, prefix_len: int = 16,
+              suffix_len: int = 4, max_new_tokens: int = 6,
+              ) -> list[Request]:
+    """The router bench's prompt-family shape, chaos-sized: shared
+    per-group prefixes keep the shadow index (and therefore warm
+    respawn) meaningful."""
+    out = []
+    for w in range(waves):
+        for g in range(n_groups):
+            prefix = [(7 + 11 * g + i) % 89 + 2 for i in range(prefix_len)]
+            suffix = [(31 + 5 * g + 3 * w + i) % 89 + 2
+                      for i in range(suffix_len)]
+            out.append(Request(prompt=prefix + suffix,
+                               max_new_tokens=max_new_tokens))
+    return out
+
+
+def _counters_regressed(samples: Sequence[dict]) -> list[str]:
+    """Counter names that ever decreased across ordered snapshots."""
+    bad = []
+    for prev, cur in zip(samples, samples[1:]):
+        for name, v in prev.items():
+            if cur.get(name, v) < v and name not in bad:
+                bad.append(name)
+    return bad
+
+
+def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
+                 n_replicas: int = 3, n_groups: int = 4,
+                 waves: int = 4, n_faults: int = 6, n_kills: int = 1,
+                 n_slots: int = 2, max_len: int = 64, chunk: int = 8,
+                 backoff_s: float = 0.01, max_restarts: int = 5,
+                 event_log: str | None = None,
+                 timeout_s: float = 300.0) -> dict:
+    """One seeded chaos campaign; returns the oracle report (see the
+    module docstring for the oracles).  ``report["ok"]`` is the AND of
+    every oracle — the smoke test and the soak loop key off it."""
+    from horovod_tpu.serving_scheduler import ServeEngine
+
+    workload = _workload(n_groups, waves)
+    names = [f"replica{i}" for i in range(n_replicas)]
+    schedule = ChaosSchedule.generate(
+        seed, replica_names=names, n_faults=n_faults, n_kills=n_kills)
+
+    # Fault-free reference: one solo engine (routing never changes
+    # tokens — the router bench asserts that — so a single engine's
+    # greedy output IS the fleet's fault-free output).
+    ref_engine = ServeEngine(params, cfg, n_slots=n_slots,
+                             max_len=max_len, chunk=chunk,
+                             prefix_cache=True, monitor=False,
+                             metrics=metrics_mod.NULL)
+    reference = ref_engine.run(workload)
+
+    # The chaos fleet: engines, registry, storm, supervisor, journal-
+    # free router (journal determinism has its own tests; the campaign
+    # exercises engine faults + kills + respawn).
+    fr = faults_mod.FaultRegistry()
+    schedule.arm(fr)
+    reg = metrics_mod.MetricsRegistry()
+    engines = [ServeEngine(params, cfg, n_slots=n_slots,
+                           max_len=max_len, chunk=chunk,
+                           prefix_cache=True, monitor=False,
+                           faults=fr, metrics=reg)
+               for _ in range(n_replicas)]
+    if event_log is None:
+        event_log = os.path.join(
+            tempfile.mkdtemp(prefix="hvd-chaos-"),
+            f"chaos-{seed}-{os.getpid()}.jsonl")
+    prior_log = os.environ.get("HVD_TPU_EVENT_LOG")
+    os.environ["HVD_TPU_EVENT_LOG"] = event_log
+
+    router = RouterServer(engines, policy="round_robin", registry=reg,
+                          faults=fr)
+    ReplicaSupervisor(router, max_restarts=max_restarts,
+                      backoff_s=backoff_s, warm_prefixes=4)
+    samples: list[dict] = []
+    results: list[Any] = []
+    deadline = time.monotonic() + timeout_s
+    try:
+        for w in range(waves):
+            wave = workload[w * n_groups:(w + 1) * n_groups]
+            rids = [router.route(r) for r in wave]
+            for rid in rids:
+                while True:
+                    res = router.result(rid, timeout=0.05)
+                    if res is not None:
+                        results.append(res)
+                        break
+                    router.poll_now()
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"chaos campaign stalled (seed={seed})")
+            samples.append(dict(reg.snapshot()["counters"]))
+        # Heal window: give the supervisor polls until every replica
+        # is routable again (backoff is tiny; this is hit-bounded by
+        # the wall-clock deadline, not by sleeps).
+        while time.monotonic() < deadline:
+            router.poll_now()
+            _, health = router.health()
+            if health["healthy"] == n_replicas:
+                break
+            time.sleep(backoff_s)
+        samples.append(dict(reg.snapshot()["counters"]))
+        router.reap_tickets(0)
+        leaked_tickets = router.memory_report()["tickets"]
+        leaked_blocks = 0
+        block_errors: list[str] = []
+        for r in router.replicas:
+            eng = getattr(r, "engine", None)
+            if eng is None:
+                continue
+            total = int(eng.pcache.k.shape[1]) - 1
+            free = eng.free_block_count() + eng.cached_block_count()
+            leaked_blocks += total - free
+            if eng.prefix is not None:
+                try:
+                    eng.prefix.check_consistency()
+                except AssertionError as e:
+                    block_errors.append(f"{r.name}: {e}")
+        _, health = router.health()
+    finally:
+        os.environ.pop("HVD_TPU_EVENT_LOG", None)
+        if prior_log is not None:
+            os.environ["HVD_TPU_EVENT_LOG"] = prior_log
+        router.stop()
+
+    fired = list(fr.log)
+    logged = [(e.get("site"), e.get("key"), e.get("hit"))
+              for e in metrics_mod.EventLog.read(event_log)
+              if e.get("kind") == "fault"]
+    missing = [f for f in fired if (f[0], f[1], f[2]) not in logged]
+    regressed = _counters_regressed(samples)
+    n_ok = sum(1 for r in results if r.status == OK)
+    mismatches = [i for i, (res, ref) in enumerate(zip(results,
+                                                       reference))
+                  if res.status == OK and list(res) != list(ref)]
+    counters = samples[-1] if samples else {}
+    kills_fired = sum(1 for s, _k, _h in fired if s == KILL_SITE)
+
+    oracles = {
+        "bit_identical": not mismatches,
+        "no_leaked_tickets": leaked_tickets == 0,
+        "no_leaked_blocks": leaked_blocks == 0 and not block_errors,
+        "metrics_monotonic": not regressed,
+        "faults_logged": not missing,
+        "healed": health["healthy"] == n_replicas,
+    }
+    return {
+        "seed": seed,
+        "schedule": schedule.to_json(),
+        "sites_fired": sorted({s for s, _k, _h in fired}),
+        "n_requests": len(workload),
+        "n_ok": n_ok,
+        "ok_fraction": n_ok / len(workload),
+        "faults_fired": len(fired),
+        "kills_fired": kills_fired,
+        "respawns": counters.get("supervisor.respawns", 0),
+        "permanent_deaths": counters.get(
+            "supervisor.permanent_deaths", 0),
+        "failovers": counters.get("router.failovers", 0),
+        "leaked_tickets": leaked_tickets,
+        "leaked_blocks": leaked_blocks,
+        "block_errors": block_errors,
+        "counter_regressions": regressed,
+        "unlogged_faults": [list(f) for f in missing],
+        "mismatched_requests": mismatches,
+        "event_log": event_log,
+        "oracles": oracles,
+        "ok": all(oracles.values()),
+    }
+
+
+def soak(params: dict, cfg: Any, *, seconds: float,
+         start_seed: int = 0, **campaign_kw: Any) -> dict:
+    """Run consecutive-seed campaigns until the wall-clock budget runs
+    out (at least one always runs).  Returns the aggregate: campaign
+    count, failing seeds with their broken oracles, total faults."""
+    t0 = time.monotonic()
+    seed = start_seed
+    reports: list[dict] = []
+    while not reports or time.monotonic() - t0 < seconds:
+        reports.append(run_campaign(params, cfg, seed=seed,
+                                    **campaign_kw))
+        seed += 1
+    failures = [{"seed": r["seed"],
+                 "oracles": {k: v for k, v in r["oracles"].items()
+                             if not v}}
+                for r in reports if not r["ok"]]
+    return {
+        "campaigns": len(reports),
+        "seconds": time.monotonic() - t0,
+        "seeds": [r["seed"] for r in reports],
+        "faults_fired": sum(r["faults_fired"] for r in reports),
+        "kills_fired": sum(r["kills_fired"] for r in reports),
+        "min_ok_fraction": min(r["ok_fraction"] for r in reports),
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
+def compare_campaigns(old: dict, new: dict, *,
+                      threshold: float = 0.1) -> tuple[bool, list[str]]:
+    """The regression gate (``chaos_run.py --compare OLD NEW``): fail
+    when any oracle that held in ``old`` broke in ``new``, or when the
+    OK fraction dropped more than ``threshold`` absolute.  Accepts
+    single-campaign or soak reports (a soak report gates on ``ok`` and
+    ``min_ok_fraction``)."""
+    problems: list[str] = []
+    for name, held in old.get("oracles", {}).items():
+        if held and not new.get("oracles", {}).get(name, True):
+            problems.append(f"oracle {name}: held before, broken now")
+    if old.get("ok", True) and not new.get("ok", True):
+        if not problems:
+            problems.append("campaign ok: passed before, fails now")
+    for key in ("ok_fraction", "min_ok_fraction"):
+        if key in old and key in new:
+            drop = old[key] - new[key]
+            if drop > threshold:
+                problems.append(
+                    f"{key} dropped {drop:.3f} "
+                    f"({old[key]:.3f} -> {new[key]:.3f}, "
+                    f"threshold {threshold})")
+    return (not problems), problems
+
+
+def measure_chaos_goodput(params: dict, cfg: Any, *, seed: int = 0,
+                          **campaign_kw: Any) -> dict:
+    """The ``serve_chaos_*`` bench arm: one seeded storm campaign,
+    reporting what fraction of the workload still terminated ``OK``
+    (the fault-free fleet completes everything, so OK fraction IS
+    goodput retention) plus the storm's shape for context."""
+    report = run_campaign(params, cfg, seed=seed, **campaign_kw)
+    return {
+        "serve_chaos_seed": seed,
+        "serve_chaos_requests": report["n_requests"],
+        "serve_chaos_faults_fired": report["faults_fired"],
+        "serve_chaos_kills_fired": report["kills_fired"],
+        "serve_chaos_respawns": report["respawns"],
+        "serve_chaos_ok_fraction": report["ok_fraction"],
+        "serve_chaos_goodput_retention": report["ok_fraction"],
+        "serve_chaos_oracles_ok": report["ok"],
+    }

@@ -32,6 +32,15 @@ Named sites currently wired:
                    replica; the router re-enqueues its in-flight
                    requests to survivors (replay keeps outputs
                    bit-identical)
+``serve.supervisor``  per respawn attempt in the
+                   :class:`~horovod_tpu.supervisor.ReplicaSupervisor`
+                   (key = replica name) — a firing rule fails that
+                   attempt, burning one unit of the replica's restart
+                   budget and advancing its backoff
+``router.journal``  per append to the router's request-journal WAL
+                   (key = record kind) — a firing rule loses that
+                   record (the request is still served; durability
+                   degrades, counted in ``router.journal_errors``)
 ``data.producer``  per batch assembled by the
                    :class:`~horovod_tpu.data.ShardedLoader` prefetch
                    thread (key = batch index)

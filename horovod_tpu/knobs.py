@@ -84,8 +84,12 @@ ENV_KNOBS = (
      "Ticks in the profiler's rolling per-phase report window."),
     ("HVD_TPU_RETRACE_FATAL", "0",
      "Raise when the retrace sentry sees a jit cache grow mid-serve."),
+    ("HVD_TPU_ROUTER_DRAIN_S", "5.0",
+     "Seconds stop() waits for in-flight requests before shutting down."),
     ("HVD_TPU_ROUTER_IMBALANCE", "4",
      "Inflight gap above which prefix_affinity falls back to least_loaded."),
+    ("HVD_TPU_ROUTER_JOURNAL", "",
+     "Path of the crash-durable request-journal JSONL WAL (unset = off)."),
     ("HVD_TPU_ROUTER_MAX_FAILOVERS", "3",
      "Failover replays allowed per request before it fails terminally."),
     ("HVD_TPU_ROUTER_MIN_FREE_KV", "0",
@@ -110,6 +114,10 @@ ENV_KNOBS = (
      "Self-drafting (prompt-lookup) speculative decode in ServeEngine."),
     ("HVD_TPU_STRAGGLER_WARN_S", "1.0",
      "Step-lag threshold in seconds before a straggler warning."),
+    ("HVD_TPU_SUPERVISE_BACKOFF_S", "0.5",
+     "Base respawn delay for a dead replica (doubles per restart)."),
+    ("HVD_TPU_SUPERVISE_MAX_RESTARTS", "3",
+     "Respawns per replica before the supervisor circuit-breaks it."),
     ("HVD_TPU_VERIFY_BLOCKS", "0",
      "Walk paged-KV block tables every serve tick (debug, slow)."),
 )

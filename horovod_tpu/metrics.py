@@ -89,6 +89,8 @@ FAULT_SITES: tuple[str, ...] = (
     "serve.cache",
     "serve.draft",
     "serve.router",
+    "serve.supervisor",
+    "router.journal",
     "data.producer",
 )
 
@@ -201,6 +203,15 @@ METRIC_HELP: dict[str, str] = {
     "router.replicas_healthy": "Replicas currently accepting routed requests",
     "router.inflight": "Routed requests not yet terminal, fleet-wide",
     "router.shadow_index_bytes": "Approximate host bytes of the per-replica shadow prefix indexes",
+    "router.journal_appends": "Records durably appended to the request-journal WAL",
+    "router.journal_errors": "Journal appends lost to a write fault (request still served)",
+    "router.journal_replays": "Incomplete journaled requests re-submitted after a router restart",
+    "router.journal_dedups": "Duplicate idempotency keys answered from the journaled result",
+    # supervisor.* — the self-healing layer (horovod_tpu.supervisor)
+    "supervisor.respawns": "Dead replicas respawned by the supervisor",
+    "supervisor.respawn_failures": "Respawn attempts that failed (fault or factory error)",
+    "supervisor.permanent_deaths": "Replicas circuit-broken to permanent-dead after exhausting restarts",
+    "supervisor.warm_prefixes": "Hot prompts replayed into a fresh engine to rewarm its prefix cache",
 }
 
 

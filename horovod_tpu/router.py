@@ -49,6 +49,24 @@ per request, *which* replica serves it:
   every pump it touches fails terminally instead of walking the whole
   fleet dead.
 
+* **Crash durability** (PR 10).  ``HVD_TPU_ROUTER_JOURNAL=<path>``
+  arms an append-only JSONL request journal (torn-line-tolerant — the
+  :class:`~horovod_tpu.metrics.EventLog` reader idiom): one ``accept``
+  record as a request is placed, one ``terminal`` record as it
+  finishes.  A restarted router replays every accept with no terminal
+  (:meth:`RouterServer.replay_journal` — greedy determinism makes the
+  replayed tokens bit-identical to what the lost incarnation would
+  have produced), and a client-supplied **idempotency key** makes
+  retries exactly-once: a duplicate key returns the journaled result
+  without touching a replica.  :meth:`RouterServer.stop` now drains —
+  bounded by ``HVD_TPU_ROUTER_DRAIN_S`` — instead of abandoning pump
+  threads with work queued; undrained requests fail terminally but
+  keep their journal accept, so a restart replays them.  Replica
+  *respawn* (a dead :class:`LocalReplica` coming back) lives one layer
+  up in :class:`~horovod_tpu.supervisor.ReplicaSupervisor`, which
+  rides :meth:`RouterServer.poll_now` and commits each respawn through
+  :meth:`RouterServer.replace_replica`.
+
 Everything is host-side bookkeeping: the router never allocates device
 memory, never adds a jit signature, and works against replicas it can
 only see through HTTP.  ``router.*`` metrics land in the router's own
@@ -628,6 +646,51 @@ def request_from_json(payload: dict) -> Request:
 
 
 # ---------------------------------------------------------------------------
+# Crash-durable request journal (the WAL a restarted router recovers from).
+# ---------------------------------------------------------------------------
+
+
+def load_journal(path: str) -> "tuple[list[dict], dict[str, dict]]":
+    """Parse a request-journal WAL into recovery state: a list of
+    *incomplete* accept records (accepted, no terminal — these must be
+    replayed) and the terminal records of every keyed request (the
+    idempotency dedup map).
+
+    The file is plain :class:`~horovod_tpu.metrics.EventLog` JSONL, so
+    the torn-line-tolerant ``EventLog.read`` does the parsing: a crash
+    mid-append costs at most the half-written last line, never the
+    records before it.  Accept/terminal pairs match on the
+    ``(pid, rid)`` the EventLog stamps automatically — rids restart at
+    0 in every router incarnation, and the pid disambiguates
+    incarnations sharing one journal file.  A key replayed across
+    several crashes may leave several incomplete accepts; one replay
+    suffices, and a key that ever reached a terminal needs none."""
+    if not path or not os.path.exists(path):
+        return [], {}
+    accepts: dict[tuple, dict] = {}
+    results: dict[str, dict] = {}
+    for rec in metrics_mod.EventLog.read(path):
+        ident = (rec.get("pid"), rec.get("rid"))
+        kind = rec.get("kind")
+        if kind == "router.accept":
+            accepts[ident] = rec
+        elif kind == "router.terminal":
+            accepts.pop(ident, None)
+            if rec.get("key") is not None:
+                results[rec["key"]] = rec
+    incomplete: list[dict] = []
+    seen_keys: set[str] = set()
+    for rec in accepts.values():
+        key = rec.get("key")
+        if key is not None:
+            if key in results or key in seen_keys:
+                continue
+            seen_keys.add(key)
+        incomplete.append(rec)
+    return incomplete, results
+
+
+# ---------------------------------------------------------------------------
 # The router itself.
 # ---------------------------------------------------------------------------
 
@@ -639,7 +702,8 @@ class _Ticket:
     cross-thread wait point."""
 
     __slots__ = ("rid", "req", "replica", "shed", "failovers",
-                 "result", "done", "done_ts", "policy")
+                 "result", "done", "done_ts", "policy", "key",
+                 "journaled")
 
     def __init__(self, rid: int, req: Request):
         self.rid = rid
@@ -651,6 +715,8 @@ class _Ticket:
         self.done = threading.Event()
         self.done_ts = 0.0                  # monotonic, for TTL reaping
         self.policy = ""
+        self.key: str | None = None         # idempotency key, if any
+        self.journaled = False              # has an accept WAL record
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -687,10 +753,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif path == "/healthz":
                 code, body = router.health()
                 self._reply(code, json.dumps(body), "application/json")
+            elif path == "/state":
+                self._reply(200, router.state_dump(), "text/plain")
             else:
                 self._reply(404, "unknown path; try /v1/generate "
                                  "/replicas /snapshot /healthz "
-                                 "/metrics\n",
+                                 "/metrics /state\n",
                             "text/plain")
         except BrokenPipeError:
             pass
@@ -707,11 +775,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n).decode())
                 req = request_from_json(payload)
+                key = payload.get("idempotency_key")
+                if key is not None and not isinstance(key, str):
+                    raise ValueError(
+                        "idempotency_key must be a string or null")
             except (ValueError, json.JSONDecodeError) as e:
                 self._reply(400, json.dumps({"error": str(e)}),
                             "application/json")
                 return
-            code, body = router.handle_generate(req)
+            code, body = router.handle_generate(req, key)
             self._reply(code, json.dumps(body), "application/json")
         except BrokenPipeError:
             pass
@@ -739,7 +811,9 @@ class RouterServer:
     lock held, so the reverse edge never forms."""
 
     _GUARDED_BY_LOCK = ("_tickets", "_views", "_shadows", "_inflight",
-                        "_routed", "_dead", "_probe_fails", "_next_rid")
+                        "_routed", "_dead", "_probe_fails", "_next_rid",
+                        "_journal_results", "_journal_inflight",
+                        "_journal_waiters")
 
     class _Server(ThreadingHTTPServer):
         daemon_threads = True
@@ -757,7 +831,9 @@ class RouterServer:
                  max_failovers: int | None = None,
                  probe_fails: int | None = None,
                  ticket_ttl_s: float | None = None,
-                 shadow_max_paths: int = 4096):
+                 shadow_max_paths: int = 4096,
+                 journal: str | None = None,
+                 drain_s: float | None = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas: list[ReplicaHandle] = []
@@ -797,6 +873,10 @@ class RouterServer:
         self.ticket_ttl_s = (
             ticket_ttl_s if ticket_ttl_s is not None else
             env_float("HVD_TPU_ROUTER_TICKET_TTL_S", 600.0))
+        self.drain_s = (drain_s if drain_s is not None else
+                        env_float("HVD_TPU_ROUTER_DRAIN_S", 5.0))
+        self.faults = (faults if faults is not None
+                       else faults_mod.FaultRegistry())
 
         self._lock = threading.Lock()
         self._next_rid = 0
@@ -812,6 +892,35 @@ class RouterServer:
         self._routed: dict[str, int] = {r.name: 0 for r in self.replicas}
         self._dead: set[str] = set()
 
+        # Crash-durable request journal (off unless a path is set).
+        # Recovery happens HERE, before any routing: incomplete accepts
+        # from a previous incarnation park in _journal_pending until
+        # start() (or an explicit replay_journal()) re-submits them, and
+        # journaled terminals seed the idempotency dedup map.
+        self.journal_path = (journal if journal is not None else
+                            os.environ.get("HVD_TPU_ROUTER_JOURNAL", "")) \
+            or None
+        self._journal: metrics_mod.EventLog | None = None
+        self._journal_results: dict[str, RequestResult] = {}
+        self._journal_inflight: dict[str, int] = {}     # key -> live rid
+        self._journal_waiters: dict[str, list[_Ticket]] = {}
+        self._journal_pending: list[dict] = []          # setup-only
+        if self.journal_path:
+            pending, terms = load_journal(self.journal_path)
+            self._journal_pending = pending
+            for key, rec in terms.items():
+                self._journal_results[key] = RequestResult(
+                    rec.get("tokens") or [], rec.get("status", FAILED))
+            self._journal = metrics_mod.EventLog(self.journal_path)
+
+        #: A :class:`~horovod_tpu.supervisor.ReplicaSupervisor`, once
+        #: attached — ticked by the poller, reported by health().
+        self.supervisor: Any = None
+        #: Optional ``(replica_name, request)`` observer fired after
+        #: each placement, outside the lock — the supervisor's
+        #: warm-prompt feed.
+        self.on_route: "Callable[[str, Request], None] | None" = None
+
         # Registered up front (literal names — the HVD005 contract) so
         # router snapshots are schema-stable from request 0; the
         # per-decision bump composes "router.routed." + policy.name.
@@ -824,6 +933,10 @@ class RouterServer:
         self.metrics.counter("router.replica_deaths")
         self.metrics.counter("router.replica_revives")
         self.metrics.counter("router.affinity_fallbacks")
+        self.metrics.counter("router.journal_appends")
+        self.metrics.counter("router.journal_errors")
+        self.metrics.counter("router.journal_replays")
+        self.metrics.counter("router.journal_dedups")
         self.metrics.histogram("router.affinity_hit_tokens")
         self.metrics.gauge("router.replicas_healthy").set(
             len(self.replicas))
@@ -855,9 +968,41 @@ class RouterServer:
                 target=self._poll_loop, name="hvd-router-poll",
                 daemon=True)
             self._poll_thread.start()
+        self.replay_journal()
         return self
 
-    def stop(self, stop_replicas: bool = True) -> None:
+    def stop(self, stop_replicas: bool = True,
+             drain_s: float | None = None) -> None:
+        """Drain, then shut down.  The drain phase waits up to
+        ``drain_s`` (default ``HVD_TPU_ROUTER_DRAIN_S``) for in-flight
+        requests to finish instead of abandoning pump threads with
+        work queued; a request still live at the deadline is failed
+        terminally — unblocking its waiters — but a journaled one
+        skips its terminal WAL record, so a restarted router replays
+        it rather than losing it."""
+        drain = self.drain_s if drain_s is None else drain_s
+        deadline = time.monotonic() + max(drain, 0.0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = sum(self._inflight.values())
+            if busy == 0:
+                break
+            time.sleep(0.005)
+        undrained: list[_Ticket] = []
+        with self._lock:
+            for t in self._tickets.values():
+                if t.replica is not None and not t.done.is_set():
+                    t.journaled = False     # keep the accept unpaired
+                    t.result = RequestResult([], FAILED, RuntimeError(
+                        "router shut down before completion"))
+                    t.done_ts = time.monotonic()
+                    undrained.append(t)
+        if undrained:
+            self.metrics.event("router.drain_abandoned",
+                               count=len(undrained),
+                               journaled=self._journal is not None)
+        for t in undrained:
+            t.done.set()
         self._poll_stop.set()
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5)
@@ -870,29 +1015,70 @@ class RouterServer:
         if stop_replicas:
             for r in self.replicas:
                 r.stop()
+        if self._journal is not None:
+            self._journal.close()
 
     # -- routing -----------------------------------------------------------
 
-    def route(self, req: Request) -> int:
+    def route(self, req: Request, *,
+              idempotency_key: str | None = None) -> int:
         """Admit-or-shed, choose a replica, submit.  Returns the router
         request id (poll :meth:`result`); a shed request gets a
-        terminal ``REJECTED`` result immediately."""
-        return self._route(req).rid
+        terminal ``REJECTED`` result immediately.
 
-    def _route(self, req: Request) -> _Ticket:
+        ``idempotency_key`` (journaled routers only) makes the request
+        exactly-once across client retries and router restarts: a key
+        whose terminal result is journaled answers from the journal
+        without touching a replica; a key still in flight shares the
+        original's outcome instead of running twice."""
+        return self._route(req, idempotency_key).rid
+
+    def _route(self, req: Request,
+               idempotency_key: str | None = None) -> _Ticket:
         self.metrics.counter("router.requests").inc()
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
             ticket = _Ticket(rid, req)
+            ticket.key = idempotency_key
             self._tickets[rid] = ticket
-            shed = self._admission_locked()
-            if shed is not None:
-                self._shed_locked(ticket, shed)
-                return ticket
-            handle, info = self._place_locked(ticket)
+            if self._journal is not None and idempotency_key is not None:
+                prior = self._journal_results.get(idempotency_key)
+                if prior is not None:
+                    # Exactly-once: the journaled terminal IS the
+                    # answer; the duplicate never reaches a replica.
+                    ticket.result = prior
+                    ticket.done_ts = time.monotonic()
+                    self.metrics.counter("router.journal_dedups").inc()
+                elif idempotency_key in self._journal_inflight:
+                    # Original still running: park on its outcome.
+                    self._journal_waiters.setdefault(
+                        idempotency_key, []).append(ticket)
+                    self.metrics.counter("router.journal_dedups").inc()
+                    return ticket
+            if ticket.result is None:
+                shed = self._admission_locked()
+                if shed is not None:
+                    self._shed_locked(ticket, shed)
+                    return ticket
+                if self._journal is not None:
+                    ticket.journaled = True
+                    if idempotency_key is not None:
+                        self._journal_inflight[idempotency_key] = rid
+                handle, info = self._place_locked(ticket)
+        if ticket.result is not None:       # journal dedup hit
+            ticket.done.set()
+            return ticket
+        if ticket.journaled:
+            # Accept is durable BEFORE the submit: a crash between the
+            # append and the callback replays the request on restart.
+            self._journal_append("router.accept", rid=rid,
+                                 key=idempotency_key,
+                                 req=request_to_json(req))
         self.metrics.event("router.route", rid=rid, replica=handle.name,
                            policy=ticket.policy, **info)
+        if self.on_route is not None:
+            self.on_route(handle.name, req)
         handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
         return ticket
 
@@ -926,12 +1112,14 @@ class RouterServer:
                 del self._tickets[rid]
         return len(dead)
 
-    def handle_generate(self, req: Request) -> tuple[int, dict]:
+    def handle_generate(self, req: Request,
+                        idempotency_key: str | None = None,
+                        ) -> tuple[int, dict]:
         """The ``POST /v1/generate`` body: route, wait, and shape the
         JSON reply.  Shed requests answer 429 (back off and retry is
         the right client response to load shedding); every other
         terminal status is a 200 whose ``status`` field speaks."""
-        ticket = self._route(req)
+        ticket = self._route(req, idempotency_key)
         with self._lock:
             # Claim the ticket immediately: the HTTP reply is its only
             # reader, and a front door that never forgets a finished
@@ -1030,6 +1218,8 @@ class RouterServer:
                     sum(self._inflight.values()))
                 ticket.done_ts = time.monotonic()
             ticket.done.set()
+            if ticket.journaled:
+                self._journal_terminal(ticket, res)
             return
         with self._lock:
             if ticket.done.is_set():
@@ -1054,13 +1244,19 @@ class RouterServer:
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
                 ticket.done_ts = time.monotonic()
-                ticket.done.set()
-                return
-            ticket.failovers += 1
-            self.metrics.counter("router.failovers").inc()
-            handle, info = self._place_locked(ticket)
+            else:
+                ticket.failovers += 1
+                self.metrics.counter("router.failovers").inc()
+                handle, info = self._place_locked(ticket)
+        if err is not None:
+            ticket.done.set()
+            if ticket.journaled:
+                self._journal_terminal(ticket, ticket.result)
+            return
         self.metrics.event("router.failover", rid=ticket.rid,
                            src=old, dst=handle.name, **info)
+        if self.on_route is not None:
+            self.on_route(handle.name, ticket.req)
         handle.submit(ticket.req,
                       lambda res2, t=ticket: self._on_done(t, res2))
 
@@ -1088,6 +1284,90 @@ class RouterServer:
         self.metrics.counter("router.replica_revives").inc()
         self.metrics.gauge("router.replicas_healthy").set(healthy)
         self.metrics.event("router.replica_revive", replica=name)
+
+    def replace_replica(self, name: str, handle: ReplicaHandle) -> None:
+        """Swap a (dead) replica's handle for a fresh one under the
+        same name and return it to the candidate set — the
+        supervisor's respawn commit point.  The shadow index survives
+        the swap: its paths are phantoms for the fresh engine's empty
+        cache (benign — one suboptimal route each) until warm replay
+        and the poller's digest feed repopulate it."""
+        if isinstance(handle, LocalReplica) and handle.on_death is None:
+            handle.on_death = self._on_replica_death
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                if r.name == name:
+                    self.replicas[i] = handle
+                    break
+            else:
+                raise KeyError(name)
+            self._probe_fails[name] = 0
+            self._views.pop(name, None)
+        self._mark_alive(name)
+
+    # -- the request journal -----------------------------------------------
+
+    def _journal_append(self, kind: str, **fields: Any) -> None:
+        """One WAL append, fault-isolated: a failed journal write (the
+        ``router.journal`` fault site, or a real disk error) degrades
+        durability — counted and evented — but never fails the
+        request being served."""
+        if self._journal is None:
+            return
+        try:
+            self.faults.check("router.journal", key=kind)
+            self._journal.emit(kind, **fields)
+        except Exception as e:
+            self.metrics.counter("router.journal_errors").inc()
+            self.metrics.event("router.journal_error", record=kind,
+                               error=str(e))
+        else:
+            self.metrics.counter("router.journal_appends").inc()
+
+    def _journal_terminal(self, ticket: _Ticket,
+                          res: RequestResult) -> None:
+        """Record a journaled request's terminal outcome and release
+        its idempotency key: the result becomes the exactly-once
+        answer for later duplicates, and every ticket parked on the
+        key completes with the same result."""
+        waiters: list[_Ticket] = []
+        with self._lock:
+            if ticket.key is not None:
+                self._journal_results[ticket.key] = res
+                self._journal_inflight.pop(ticket.key, None)
+                waiters = self._journal_waiters.pop(ticket.key, [])
+        self._journal_append(
+            "router.terminal", rid=ticket.rid, key=ticket.key,
+            status=res.status, tokens=list(res),
+            error=None if res.error is None else str(res.error))
+        for w in waiters:
+            with self._lock:
+                if w.done.is_set():
+                    continue
+                w.result = res
+                w.done_ts = time.monotonic()
+            w.done.set()
+
+    def replay_journal(self) -> int:
+        """Re-submit every journaled accept with no terminal record
+        (crash recovery; :meth:`start` runs this once).  Greedy
+        determinism makes each replayed result bit-identical to what
+        the lost incarnation would have produced, and keyed requests
+        land back in the dedup map so their clients' retries find
+        them.  Returns the number of requests replayed."""
+        pending, self._journal_pending = self._journal_pending, []
+        n = 0
+        for rec in pending:
+            try:
+                req = request_from_json(rec.get("req") or {})
+            except ValueError:
+                continue    # poisoned or truncated record: skip it
+            self.metrics.counter("router.journal_replays").inc()
+            self.metrics.event("router.journal_replay",
+                               key=rec.get("key"))
+            self._route(req, rec.get("key"))
+            n += 1
+        return n
 
     # -- polling + reports -------------------------------------------------
 
@@ -1124,6 +1404,9 @@ class RouterServer:
                 self._mark_dead(r.name)       # no-op when already dead
         self.metrics.gauge("router.shadow_index_bytes").set(
             self._shadow_bytes())
+        sup = self.supervisor
+        if sup is not None:
+            sup.tick()
         self.reap_tickets()
 
     def _poll_loop(self) -> None:
@@ -1137,13 +1420,56 @@ class RouterServer:
 
     def health(self) -> tuple[int, dict]:
         """``GET /healthz``: 200 while at least one replica is
-        routable, 503 once the whole fleet is dead."""
+        routable, 503 once the whole fleet is dead.  ``degraded`` is
+        true while the fleet runs on its supervisor's restart budget
+        (a respawned or circuit-broken replica) — still a 200, but a
+        deploy gate should notice."""
         with self._lock:
             healthy = [r.name for r in self.replicas
                        if r.name not in self._dead]
             body = {"ok": bool(healthy), "replicas": len(self.replicas),
                     "healthy": len(healthy), "pid": os.getpid()}
+        sup = self.supervisor
+        body["degraded"] = bool(sup is not None and sup.degraded())
         return (200 if body["ok"] else 503), body
+
+    def state_dump(self) -> str:
+        """Human-readable router state (the engine ``state_dump``
+        contract one layer up; served at ``GET /state``): per-replica
+        health and routing counts, ticket/journal bookkeeping, and —
+        with a supervisor attached — each replica's restart history."""
+        lines = [f"RouterServer policy={self.policy.name} "
+                 f"port={self.port} pid={os.getpid()}"]
+        with self._lock:
+            n_tickets = len(self._tickets)
+            n_done = sum(1 for t in self._tickets.values()
+                         if t.done.is_set())
+            dead = set(self._dead)
+            rows = [(r.name, self._routed.get(r.name, 0),
+                     self._inflight.get(r.name, 0))
+                    for r in self.replicas]
+            n_keys = len(self._journal_results)
+            n_inflight_keys = len(self._journal_inflight)
+        lines.append(f"  tickets: {n_tickets} ({n_done} terminal)")
+        if self.journal_path:
+            lines.append(f"  journal: {self.journal_path} "
+                         f"(keys={n_keys} "
+                         f"inflight_keys={n_inflight_keys})")
+        for name, routed, infl in rows:
+            lines.append(f"  replica {name}: "
+                         f"{'DEAD' if name in dead else 'up'} "
+                         f"routed={routed} inflight={infl}")
+        sup = self.supervisor
+        if sup is not None:
+            for name, st in sorted(sup.state().items()):
+                hist = " ".join("ok" if h["ok"] else "fail"
+                                for h in st["history"])
+                lines.append(
+                    f"  supervisor {name}: "
+                    f"restarts={st['restarts']}/{st['max_restarts']}"
+                    + (" PERMANENT-DEAD" if st["permanent_dead"] else "")
+                    + (f" history=[{hist}]" if hist else ""))
+        return "\n".join(lines) + "\n"
 
     def replicas_report(self) -> list[dict]:
         """``GET /replicas``: per-replica routing/health detail the

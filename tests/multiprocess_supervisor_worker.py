@@ -1,0 +1,62 @@
+"""Replica-hosting worker for the supervisor SIGKILL gang test
+(tests/test_chaos.py).
+
+The inverse of ``multiprocess_router_worker.py``: instead of being a
+client of the launcher's router, this process IS a replica backend —
+a single-engine :class:`~horovod_tpu.router.RouterServer` bound to
+the launcher-chosen ``REPLICA_PORT``, which the launcher fronts with
+an :class:`~horovod_tpu.router.HttpReplica`.  The launcher SIGKILLs
+this process mid-stream (real process death, not an injected fault),
+asserts the fleet's payloads stay byte-identical through failover,
+and lets its :class:`~horovod_tpu.supervisor.ReplicaSupervisor`
+relaunch the worker out-of-band — a fresh copy of this script on the
+same port, revived through the router's probe path.
+
+Prints ``WORKER_READY <port>`` once serving (engine pre-warmed so the
+first routed request never pays compile inside a client timeout),
+then blocks until killed.
+"""
+
+import faulthandler
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:        # launched by script path, not -m
+    sys.path.insert(0, REPO)
+
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    float(os.environ.get("HVD_TPU_WORKER_DUMP_AFTER_S", "300")),
+    exit=False)
+
+
+def main() -> None:
+    port = int(os.environ["REPLICA_PORT"])
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.router import RouterServer
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import ServeEngine
+
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=64, chunk=8,
+                      prefix_cache=True, monitor=False)
+    # Pre-compile with a token family the test workload never shares a
+    # first chunk with (the router bench's warmup idiom).
+    warm = eng.run([Request(prompt=[1] * 9, max_new_tokens=2)])
+    assert all(r.ok for r in warm)
+    router = RouterServer([eng], policy="round_robin",
+                          port=port).start()
+    print(f"WORKER_READY {router.port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()
