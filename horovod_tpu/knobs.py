@@ -90,6 +90,8 @@ ENV_KNOBS = (
      "Inflight gap above which prefix_affinity falls back to least_loaded."),
     ("HVD_TPU_ROUTER_JOURNAL", "",
      "Path of the crash-durable request-journal JSONL WAL (unset = off)."),
+    ("HVD_TPU_ROUTER_JOURNAL_KEYS", "4096",
+     "Idempotency-key results kept for dedup (LRU) and after compaction."),
     ("HVD_TPU_ROUTER_MAX_FAILOVERS", "3",
      "Failover replays allowed per request before it fails terminally."),
     ("HVD_TPU_ROUTER_MIN_FREE_KV", "0",

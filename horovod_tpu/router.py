@@ -662,9 +662,13 @@ def load_journal(path: str) -> "tuple[list[dict], dict[str, dict]]":
     records before it.  Accept/terminal pairs match on the
     ``(pid, rid)`` the EventLog stamps automatically — rids restart at
     0 in every router incarnation, and the pid disambiguates
-    incarnations sharing one journal file.  A key replayed across
-    several crashes may leave several incomplete accepts; one replay
-    suffices, and a key that ever reached a terminal needs none."""
+    incarnations sharing one journal file.  A ``router.replayed``
+    marker retires an accept the same way a terminal does: the
+    replaying incarnation routed the request under its own fresh
+    accept record, so the original must not replay again on the
+    restart after next.  A key replayed across several crashes may
+    leave several incomplete accepts; one replay suffices, and a key
+    that ever reached a terminal needs none."""
     if not path or not os.path.exists(path):
         return [], {}
     accepts: dict[tuple, dict] = {}
@@ -677,7 +681,13 @@ def load_journal(path: str) -> "tuple[list[dict], dict[str, dict]]":
         elif kind == "router.terminal":
             accepts.pop(ident, None)
             if rec.get("key") is not None:
+                # Pop-then-insert so dict order is latest-terminal
+                # order — the router's LRU bound keeps the NEWEST
+                # keys, so a re-terminated key must move to the back.
+                results.pop(rec["key"], None)
                 results[rec["key"]] = rec
+        elif kind == "router.replayed":
+            accepts.pop(ident, None)
     incomplete: list[dict] = []
     seen_keys: set[str] = set()
     for rec in accepts.values():
@@ -688,6 +698,23 @@ def load_journal(path: str) -> "tuple[list[dict], dict[str, dict]]":
             seen_keys.add(key)
         incomplete.append(rec)
     return incomplete, results
+
+
+def compact_journal(path: str, keep: "Sequence[dict]") -> None:
+    """Rewrite the WAL to just ``keep`` (the records recovery still
+    needs: unpaired accepts and the keyed terminals that seed the
+    dedup map).  Without this every restart would re-read — and the
+    file would forever carry — each paired accept/terminal of every
+    request ever served.  Records are written back verbatim (their
+    original ``pid``/``rid``/``ts`` intact, so cross-incarnation
+    pairing still works) via a temp file + ``os.replace``: a crash
+    mid-compaction leaves either the old journal or the new one,
+    never a half-written file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for rec in keep:
+            f.write(json.dumps(rec) + "\n")
+    os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
@@ -833,6 +860,7 @@ class RouterServer:
                  ticket_ttl_s: float | None = None,
                  shadow_max_paths: int = 4096,
                  journal: str | None = None,
+                 journal_keys: int | None = None,
                  drain_s: float | None = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -900,6 +928,14 @@ class RouterServer:
         self.journal_path = (journal if journal is not None else
                             os.environ.get("HVD_TPU_ROUTER_JOURNAL", "")) \
             or None
+        # Keyed terminal results kept for idempotency dedup, LRU by
+        # terminal/dedup-hit time.  Past the bound, exactly-once
+        # degrades to at-least-once (an evicted key's duplicate
+        # re-runs) — the price of a router whose memory and WAL don't
+        # grow with lifetime traffic.
+        self.journal_keys = max(1, int(
+            journal_keys if journal_keys is not None else
+            env_float("HVD_TPU_ROUTER_JOURNAL_KEYS", 4096)))
         self._journal: metrics_mod.EventLog | None = None
         self._journal_results: dict[str, RequestResult] = {}
         self._journal_inflight: dict[str, int] = {}     # key -> live rid
@@ -908,9 +944,15 @@ class RouterServer:
         if self.journal_path:
             pending, terms = load_journal(self.journal_path)
             self._journal_pending = pending
-            for key, rec in terms.items():
+            # File order is terminal order, so the newest keys win the
+            # bound; compaction drops everything recovery no longer
+            # needs (paired records, evicted keys) from the file too.
+            kept = list(terms.items())[-self.journal_keys:]
+            for key, rec in kept:
                 self._journal_results[key] = RequestResult(
                     rec.get("tokens") or [], rec.get("status", FAILED))
+            compact_journal(self.journal_path,
+                            pending + [rec for _, rec in kept])
             self._journal = metrics_mod.EventLog(self.journal_path)
 
         #: A :class:`~horovod_tpu.supervisor.ReplicaSupervisor`, once
@@ -997,6 +1039,21 @@ class RouterServer:
                         "router shut down before completion"))
                     t.done_ts = time.monotonic()
                     undrained.append(t)
+            # Parked idempotency duplicates have replica=None, so the
+            # scan above misses them — and the original they wait on
+            # was just failed WITHOUT a _journal_terminal (its accept
+            # must stay unpaired for replay), so nothing will ever
+            # release them.  Fail them here or their handle_generate
+            # threads block forever on done.wait().
+            for waiters in self._journal_waiters.values():
+                for w in waiters:
+                    if not w.done.is_set():
+                        w.result = RequestResult([], FAILED, RuntimeError(
+                            "router shut down before completion"))
+                        w.done_ts = time.monotonic()
+                        undrained.append(w)
+            self._journal_waiters.clear()
+            self._journal_inflight.clear()
         if undrained:
             self.metrics.event("router.drain_abandoned",
                                count=len(undrained),
@@ -1043,10 +1100,13 @@ class RouterServer:
             ticket.key = idempotency_key
             self._tickets[rid] = ticket
             if self._journal is not None and idempotency_key is not None:
-                prior = self._journal_results.get(idempotency_key)
+                prior = self._journal_results.pop(idempotency_key, None)
                 if prior is not None:
                     # Exactly-once: the journaled terminal IS the
                     # answer; the duplicate never reaches a replica.
+                    # Re-insert to refresh LRU recency — a key still
+                    # being retried is the last one to evict.
+                    self._journal_results[idempotency_key] = prior
                     ticket.result = prior
                     ticket.done_ts = time.monotonic()
                     self.metrics.counter("router.journal_dedups").inc()
@@ -1120,12 +1180,16 @@ class RouterServer:
         the right client response to load shedding); every other
         terminal status is a 200 whose ``status`` field speaks."""
         ticket = self._route(req, idempotency_key)
-        with self._lock:
-            # Claim the ticket immediately: the HTTP reply is its only
-            # reader, and a front door that never forgets a finished
-            # request leaks prompt+result tokens without bound.
-            self._tickets.pop(ticket.rid, None)
         ticket.done.wait()
+        with self._lock:
+            # Claim the ticket with the reply: the HTTP reply is its
+            # only reader, and a front door that never forgets a
+            # finished request leaks prompt+result tokens without
+            # bound.  The claim must come AFTER the wait — a ticket
+            # popped at entry is invisible to stop()'s undrained scan,
+            # which would leave this handler thread blocked forever on
+            # a shutdown-abandoned request.
+            self._tickets.pop(ticket.rid, None)
         res = ticket.result
         body = {"rid": ticket.rid, "status": res.status,
                 "tokens": list(res),
@@ -1334,6 +1398,9 @@ class RouterServer:
         with self._lock:
             if ticket.key is not None:
                 self._journal_results[ticket.key] = res
+                while len(self._journal_results) > self.journal_keys:
+                    self._journal_results.pop(
+                        next(iter(self._journal_results)))
                 self._journal_inflight.pop(ticket.key, None)
                 waiters = self._journal_waiters.pop(ticket.key, [])
         self._journal_append(
@@ -1354,18 +1421,39 @@ class RouterServer:
         determinism makes each replayed result bit-identical to what
         the lost incarnation would have produced, and keyed requests
         land back in the dedup map so their clients' retries find
-        them.  Returns the number of requests replayed."""
+        them.  Each replay routes under THIS incarnation's own fresh
+        accept record, so once it is durable a ``router.replayed``
+        marker retires the original accept — without it the original
+        would stay forever unpaired and re-run on every future
+        restart, not just this one.  Returns the number of requests
+        replayed."""
         pending, self._journal_pending = self._journal_pending, []
         n = 0
         for rec in pending:
             try:
                 req = request_from_json(rec.get("req") or {})
             except ValueError:
-                continue    # poisoned or truncated record: skip it
+                # Poisoned or truncated record: it can never replay,
+                # so retire it rather than re-parse-and-skip it in
+                # every incarnation from now on.
+                self._journal_append("router.replayed",
+                                     pid=rec.get("pid"),
+                                     rid=rec.get("rid"),
+                                     key=rec.get("key"), poisoned=True)
+                continue
             self.metrics.counter("router.journal_replays").inc()
             self.metrics.event("router.journal_replay",
                                key=rec.get("key"))
-            self._route(req, rec.get("key"))
+            ticket = self._route(req, rec.get("key"))
+            if ticket.journaled:
+                # The fresh accept hit the WAL inside _route, so the
+                # request now survives on its own record; a shed
+                # replay (journaled=False) keeps the original accept
+                # live for the next incarnation instead.
+                self._journal_append("router.replayed",
+                                     pid=rec.get("pid"),
+                                     rid=rec.get("rid"),
+                                     key=rec.get("key"))
             n += 1
         return n
 

@@ -255,10 +255,12 @@ class ReplicaSupervisor:
                     {"ok": False, "error": str(e)})
             return False
         with self._lock:
-            self._records[name].history.append({"ok": True, "error": None})
+            rec = self._records[name]
+            rec.history.append({"ok": True, "error": None})
+            restarts = rec.restarts
         self.metrics.counter("supervisor.respawns").inc()
         self.metrics.event("supervisor.respawn", replica=name,
-                           restarts=self._records[name].restarts,
+                           restarts=restarts,
                            out_of_band=replacement is None)
         if replacement is None:
             return False    # out-of-band: probes will revive the handle
@@ -307,7 +309,7 @@ class ReplicaSupervisor:
                 eng.run([Request(prompt=list(prompt), max_new_tokens=1)])
                 self.metrics.counter("supervisor.warm_prefixes").inc()
             except Exception:
-                return
+                continue    # one bad prompt must not cold-start the rest
 
 
 def supervise(router: RouterServer,

@@ -29,6 +29,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -321,6 +322,139 @@ def test_drain_timeout_fails_open_and_replays_next_incarnation(
     assert incomplete == []                     # debt paid
 
 
+def test_stop_releases_parked_idempotency_waiters(tmp_path):
+    # A duplicate parked on an in-flight key has replica=None and no
+    # accept record of its own; stop() must fail it explicitly or its
+    # handle_generate thread waits on done forever.
+    path = str(tmp_path / "journal.jsonl")
+    req = Request(prompt=[5, 17, 42], max_new_tokens=4)
+    hole = _BlackHole()
+    router = RouterServer([hole], journal=path)
+    rid_orig = router.route(req, idempotency_key="stuck")
+    rid_dup = router.route(req, idempotency_key="stuck")
+    assert len(hole.cbs) == 1                   # dup parked, not routed
+    router.stop(drain_s=0.05)
+    for rid in (rid_orig, rid_dup):
+        res = router.result(rid, timeout=0)     # no wait: both released
+        assert res is not None and res.status == FAILED
+        assert "shut down" in str(res.error)
+    assert router._journal_waiters == {}
+    assert router._journal_inflight == {}
+    # Only the original's accept is owed a replay.
+    incomplete, terms = load_journal(path)
+    assert [r["key"] for r in incomplete] == ["stuck"]
+    assert terms == {}
+
+
+def test_stop_releases_http_handler_threads(tmp_path):
+    # handle_generate claims its ticket; were the claim at entry, the
+    # ticket would be invisible to stop()'s undrained scan and both
+    # the original's and the parked duplicate's handler threads would
+    # block on done.wait() forever.
+    req = Request(prompt=[5, 17, 42], max_new_tokens=4)
+    hole = _BlackHole()
+    router = RouterServer([hole], journal=str(tmp_path / "j.jsonl"))
+    out = []
+    threads = [threading.Thread(
+        target=lambda: out.append(router.handle_generate(req, "k")))
+        for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 10
+    while len(hole.cbs) < 1 or len(router._journal_waiters.get("k", [])) < 1:
+        assert time.time() < deadline, "requests never reached the router"
+        time.sleep(0.01)
+    router.stop(drain_s=0.05)
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "handler thread still blocked after stop()"
+    assert sorted(body["status"] for _code, body in out) == [FAILED, FAILED]
+    with router._lock:
+        assert router._tickets == {}            # both claimed on reply
+
+
+def test_unkeyed_replay_converges_across_restarts(world, tmp_path):
+    cfg, params = world
+    path = str(tmp_path / "journal.jsonl")
+    # Incarnation 1 crashed with an unkeyed accept on the books (pid
+    # forged so its ident can't collide with this process's replay —
+    # real incarnations are distinct processes).
+    log = EventLog(path)
+    log.emit("router.accept", pid=424242, rid=0, key=None,
+             req={"prompt": [5, 17, 42], "max_new_tokens": 4})
+    log.close()
+    # Incarnation 2 replays it once; the router.replayed marker retires
+    # the ORIGINAL accept, so the replay's own accept/terminal pair is
+    # the only record of the request from here on.
+    router = RouterServer(_engines(params, cfg, 1), policy="round_robin",
+                          journal=path)
+    try:
+        assert router.replay_journal() == 1
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if not load_journal(path)[0]:
+                break
+            time.sleep(0.05)
+        incomplete, _ = load_journal(path)
+        assert incomplete == []
+    finally:
+        router.stop()
+    # Incarnation 3 owes nothing — without the marker the original
+    # accept would re-run here (and on every restart forever).
+    router = RouterServer(_engines(params, cfg, 1), policy="round_robin",
+                          journal=path)
+    try:
+        assert router.replay_journal() == 0
+        assert router.metrics.snapshot()["counters"][
+            "router.journal_replays"] == 0
+    finally:
+        router.stop()
+
+
+def test_journal_keys_lru_bound_and_startup_compaction(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    hole = _BlackHole()
+    router = RouterServer([hole], journal=path, journal_keys=2)
+
+    def run(key, tokens):
+        rid = router.route(Request(prompt=[2, 3], max_new_tokens=1),
+                           idempotency_key=key)
+        hole.cbs[-1](RequestResult(tokens, OK))
+        return router.result(rid, timeout=10)
+
+    run("k1", [1])
+    run("k2", [2])
+    run("k3", [3])
+    with router._lock:
+        assert list(router._journal_results) == ["k2", "k3"]  # k1 evicted
+    # An evicted key's duplicate re-runs (at-least-once past the bound);
+    # a kept key still dedups without touching the replica.
+    n_subs = len(hole.cbs)
+    run("k1", [1])
+    assert len(hole.cbs) == n_subs + 1
+    rid = router.route(Request(prompt=[2, 3], max_new_tokens=1),
+                       idempotency_key="k3")
+    assert len(hole.cbs) == n_subs + 1
+    assert list(router.result(rid, timeout=10)) == [3]
+    with router._lock:
+        # The k3 dedup hit refreshed its recency past k1's re-run.
+        assert list(router._journal_results) == ["k1", "k3"]
+    router.stop()
+
+    # Startup compaction: the WAL shrinks to what recovery needs — the
+    # newest journal_keys keyed terminals, no paired accepts.
+    router = RouterServer([_BlackHole()], journal=path, journal_keys=2)
+    try:
+        with open(path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        assert [r["kind"] for r in recs] == ["router.terminal"] * 2
+        assert sorted(r["key"] for r in recs) == ["k1", "k3"]
+        with router._lock:
+            assert sorted(router._journal_results) == ["k1", "k3"]
+    finally:
+        router.stop()
+
+
 # -- the supervisor ----------------------------------------------------------
 
 
@@ -398,6 +532,37 @@ def test_supervisor_fault_site_burns_budget(world):
         counters = router.metrics.snapshot()["counters"]
         assert counters["supervisor.respawn_failures"] == 1
         assert counters["supervisor.respawns"] == 1
+    finally:
+        router.stop()
+
+
+def test_supervisor_warm_continues_past_bad_prompt():
+    hole = _BlackHole()
+    router = RouterServer([hole])
+    sup = ReplicaSupervisor(router, warm_prefixes=4)
+    try:
+        bad, good = tuple(range(8)), tuple(range(100, 108))
+        for p in (good, bad):                   # bad is newer → tried first
+            sup._observe_route("hole", Request(prompt=list(p),
+                                               max_new_tokens=1))
+            with router._lock:
+                router._shadows["hole"].observe(list(p))
+
+        class _Eng:
+            prefix = object()                   # enables warm-up
+            ran: list = []
+
+            def run(self, reqs):
+                if tuple(reqs[0].prompt) == bad:
+                    raise RuntimeError("poisoned warm prompt")
+                self.ran.append(tuple(reqs[0].prompt))
+
+        eng = _Eng()
+        sup._warm(eng, "hole")
+        # One bad prompt must not cold-start the rest of the warm set.
+        assert eng.ran == [good]
+        assert router.metrics.snapshot()["counters"][
+            "supervisor.warm_prefixes"] == 1
     finally:
         router.stop()
 
