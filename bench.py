@@ -835,6 +835,74 @@ def _bench_serve_chaos(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_load(hvd, on_tpu: bool) -> dict:
+    """Open-loop saturation arm (extras, TPU only): seeded Poisson
+    arrivals stepped across an offered-RPS ladder against a routed
+    2-replica fleet (``horovod_tpu.loadgen.measure_saturation``).
+    Unlike every closed-loop ``serve_*`` arm above, arrivals are never
+    back-pressured by completions, so this measures the saturation
+    curve a front door actually has: client-observed p50/p99 TTFT and
+    TPOT per rung, the goodput knee, shed/timeout rates, and the
+    per-phase e2e attribution at the knee (acceptance bar:
+    ``serve_load_attr_coverage_knee >= 0.95`` — the named phases
+    explain the latency).  The full sweep report is dumped to
+    ``serve_load_report.json`` for ``tools/load_report.py`` rendering
+    and its ``--compare`` regression gate."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.loadgen import measure_saturation
+    from horovod_tpu.models import llama
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, short rungs, a ladder
+        # that still drives the tiny fleet well past its knee.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        kw = dict(ladder=(4.0, 16.0, 64.0, 256.0), duration_s=0.5,
+                  n_replicas=2, n_slots=4, chunk=8)
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        kw = dict(ladder=(2.0, 8.0, 32.0, 128.0), duration_s=2.0,
+                  n_replicas=2, n_slots=8, chunk=32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    r = measure_saturation(params, cfg, seed=0, **kw)
+    path = os.path.join(os.environ.get("HVD_TPU_BENCH_CACHE") or ".",
+                        "serve_load_report.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(r, f, indent=2, sort_keys=True)
+    except OSError:
+        path = ""                   # read-only cwd: metrics still land
+    return {
+        "serve_load_knee_rps": r["serve_load_knee_rps"],
+        "serve_load_knee_goodput_rps": round(
+            r["serve_load_knee_goodput_rps"], 2),
+        "serve_load_p99_ttft_knee_ms": round(
+            r["serve_load_p99_ttft_knee_ms"], 2),
+        "serve_load_p99_tpot_knee_ms": round(
+            r["serve_load_p99_tpot_knee_ms"], 3),
+        "serve_load_attr_coverage_knee": round(
+            r["serve_load_attr_coverage_knee"], 3),
+        "serve_load_p99_ttft_monotone":
+            r["serve_load_p99_ttft_monotone"],
+        "serve_load_shed_rate_top": round(
+            r["serve_load_shed_rate_top"], 3),
+        "serve_load_timeout_rate_top": round(
+            r["serve_load_timeout_rate_top"], 3),
+        "serve_load_requests": r["serve_load_requests"],
+        "serve_load_report_path": path,
+        "serve_load_shape": (
+            f"r{kw['n_replicas']}_l{len(kw['ladder'])}_"
+            f"d{kw['duration_s']}_poisson_seed0"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1340,7 +1408,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     for fn in (_bench_fusion, _bench_serving,
                _bench_serving_overcommit, _bench_serve_prefix,
                _bench_serve_spec, _bench_serve_router,
-               _bench_serve_chaos,
+               _bench_serve_chaos, _bench_serve_load,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):

@@ -74,6 +74,16 @@ ENV_KNOBS = (
      "JSONL request-lifecycle event-log output path."),
     ("HVD_TPU_FLASH_BWD", "pallas",
      "Flash-attention backward implementation: pallas or blockwise."),
+    ("HVD_TPU_LOAD_DURATION_S", "1.0",
+     "Seconds of offered arrivals per saturation-sweep rung."),
+    ("HVD_TPU_LOAD_LADDER", "",
+     "Comma-separated offered-RPS rungs for the saturation sweep."),
+    ("HVD_TPU_LOAD_PROCESS", "poisson",
+     "Load-harness arrival process: poisson, bursty, or fixed."),
+    ("HVD_TPU_LOAD_SEED", "0",
+     "Seed for load-harness arrival schedules and request mixes."),
+    ("HVD_TPU_LOAD_TIMEOUT_S", "60",
+     "Seconds the load harness waits for late replies per rung."),
     ("HVD_TPU_MONITOR_PORT", "",
      "Port for the per-rank /metrics + /healthz HTTP exporter."),
     ("HVD_TPU_NEGOTIATE_TIMEOUT_S", "60",

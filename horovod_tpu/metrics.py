@@ -207,6 +207,12 @@ METRIC_HELP: dict[str, str] = {
     "router.journal_errors": "Journal appends lost to a write fault (request still served)",
     "router.journal_replays": "Incomplete journaled requests re-submitted after a router restart",
     "router.journal_dedups": "Duplicate idempotency keys answered from the journaled result",
+    "router.route_decision_s": "Seconds the routing policy spent choosing and booking a replica",
+    "router.admission_s": "Seconds spent in router admission control per accepted-or-shed request",
+    "router.journal_append_s": "Seconds appending the durable accept record to the journal WAL",
+    "router.replica_queue_s": "Seconds between router submit and engine enqueue (replica inbox wait)",
+    "router.e2e_s": "Seconds from router receive to terminal result, as the client observes",
+    "router.failover_hops": "Failover replays one request took before reaching a terminal result",
     # supervisor.* — the self-healing layer (horovod_tpu.supervisor)
     "supervisor.respawns": "Dead replicas respawned by the supervisor",
     "supervisor.respawn_failures": "Respawn attempts that failed (fault or factory error)",

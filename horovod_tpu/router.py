@@ -541,8 +541,14 @@ class HttpReplica(ReplicaHandle):
                 with urllib.request.urlopen(
                         http_req, timeout=timeout_s) as resp:
                     body = json.loads(resp.read().decode())
-                done_cb(RequestResult(body.get("tokens", []),
-                                      body.get("status", FAILED)))
+                res = RequestResult(body.get("tokens", []),
+                                    body.get("status", FAILED))
+                tr = body.get("trace")
+                if isinstance(tr, dict):
+                    # Remote trace dict (remote clock domain): pass it
+                    # through so attribution still sees the *_s spans.
+                    res.trace = tr
+                done_cb(res)
             except (TimeoutError, socket.timeout) as e:
                 # Slow-but-alive backend: fail, don't duplicate.
                 done_cb(RequestResult([], FAILED, e))
@@ -726,11 +732,19 @@ class _Ticket:
     """One routed request's lifecycle inside the router: which replica
     holds it, whether it was shed, and its terminal result.  All fields
     are mutated under the owning router's lock; ``done`` is the only
-    cross-thread wait point."""
+    cross-thread wait point.
+
+    The ``*_ts`` / ``*_s`` span fields are the router-side half of
+    end-to-end latency attribution (:meth:`RouterServer.request_trace`):
+    receive → admission → route decision → journal append → submit,
+    all ``time.monotonic`` so they join the engine
+    :class:`~horovod_tpu.metrics.Trace` stamps exactly (same process,
+    same clock)."""
 
     __slots__ = ("rid", "req", "replica", "shed", "failovers",
                  "result", "done", "done_ts", "policy", "key",
-                 "journaled")
+                 "journaled", "recv_ts", "submit_ts", "admission_s",
+                 "route_decision_s", "journal_s")
 
     def __init__(self, rid: int, req: Request):
         self.rid = rid
@@ -744,6 +758,11 @@ class _Ticket:
         self.policy = ""
         self.key: str | None = None         # idempotency key, if any
         self.journaled = False              # has an accept WAL record
+        self.recv_ts = time.monotonic()     # front-door arrival
+        self.submit_ts = 0.0                # first replica submit
+        self.admission_s = 0.0              # admission-control check
+        self.route_decision_s = 0.0         # policy choose + booking
+        self.journal_s = 0.0                # accept WAL append
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -980,6 +999,12 @@ class RouterServer:
         self.metrics.counter("router.journal_replays")
         self.metrics.counter("router.journal_dedups")
         self.metrics.histogram("router.affinity_hit_tokens")
+        self.metrics.histogram("router.route_decision_s")
+        self.metrics.histogram("router.admission_s")
+        self.metrics.histogram("router.journal_append_s")
+        self.metrics.histogram("router.replica_queue_s")
+        self.metrics.histogram("router.e2e_s")
+        self.metrics.histogram("router.failover_hops")
         self.metrics.gauge("router.replicas_healthy").set(
             len(self.replicas))
         self.metrics.gauge("router.inflight").set(0)
@@ -1117,7 +1142,9 @@ class RouterServer:
                     self.metrics.counter("router.journal_dedups").inc()
                     return ticket
             if ticket.result is None:
+                t0 = time.monotonic()
                 shed = self._admission_locked()
+                ticket.admission_s = time.monotonic() - t0
                 if shed is not None:
                     self._shed_locked(ticket, shed)
                     return ticket
@@ -1125,20 +1152,31 @@ class RouterServer:
                     ticket.journaled = True
                     if idempotency_key is not None:
                         self._journal_inflight[idempotency_key] = rid
+                t0 = time.monotonic()
                 handle, info = self._place_locked(ticket)
+                ticket.route_decision_s = time.monotonic() - t0
         if ticket.result is not None:       # journal dedup hit
             ticket.done.set()
             return ticket
         if ticket.journaled:
             # Accept is durable BEFORE the submit: a crash between the
             # append and the callback replays the request on restart.
+            t0 = time.monotonic()
             self._journal_append("router.accept", rid=rid,
                                  key=idempotency_key,
                                  req=request_to_json(req))
+            ticket.journal_s = time.monotonic() - t0
+            self.metrics.histogram("router.journal_append_s").observe(
+                ticket.journal_s)
+        self.metrics.histogram("router.admission_s").observe(
+            ticket.admission_s)
+        self.metrics.histogram("router.route_decision_s").observe(
+            ticket.route_decision_s)
         self.metrics.event("router.route", rid=rid, replica=handle.name,
                            policy=ticket.policy, **info)
         if self.on_route is not None:
             self.on_route(handle.name, req)
+        ticket.submit_ts = time.monotonic()
         handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
         return ticket
 
@@ -1153,6 +1191,59 @@ class RouterServer:
         if not ticket.done.wait(timeout):
             return None
         return ticket.result
+
+    def request_trace(self, rid: int) -> "dict | None":
+        """The merged end-to-end latency trace for a finished rid:
+        the engine-side :class:`~horovod_tpu.metrics.Trace` fields
+        (queue wait, TTFT, decode cadence) plus a ``router`` sub-dict
+        of front-door spans (receive → admission → route decision →
+        journal append → submit → done).  ``None`` while the request
+        is still in flight; ``KeyError`` for an unknown/reaped rid —
+        read it before the ticket TTL, like :meth:`result`."""
+        with self._lock:
+            ticket = self._tickets.get(rid)
+        if ticket is None:
+            raise KeyError(f"unknown router rid {rid}")
+        if not ticket.done.is_set():
+            return None
+        return self._merged_trace(ticket)
+
+    def _merged_trace(self, ticket: _Ticket) -> dict:
+        """Join the engine trace with router-side spans.  All stamps
+        are ``time.monotonic`` in THIS process, so local-replica engine
+        stamps subtract cleanly from router stamps; an HTTP replica's
+        trace arrives as a dict in the remote clock domain and is
+        passed through untouched (its ``*_s`` durations still join)."""
+        base: dict = {}
+        res = ticket.result
+        tr = getattr(res, "trace", None)
+        if hasattr(tr, "to_dict"):
+            base = tr.to_dict()
+        elif isinstance(tr, dict):
+            base = {k: v for k, v in tr.items() if k != "router"}
+        router: dict = {
+            "recv_ts": ticket.recv_ts,
+            "submit_ts": ticket.submit_ts or None,
+            "done_ts": ticket.done_ts or None,
+            "route_decision_s": ticket.route_decision_s,
+            "admission_s": ticket.admission_s,
+            "journal_append_s": ticket.journal_s,
+            "accept_to_submit_s": (ticket.submit_ts - ticket.recv_ts
+                                   if ticket.submit_ts > 0 else None),
+            "failovers": ticket.failovers,
+            "replica": ticket.replica,
+            "shed": ticket.shed,
+        }
+        if ticket.done_ts > 0:
+            router["e2e_s"] = ticket.done_ts - ticket.recv_ts
+        enq = getattr(tr, "enqueue_ts", None)
+        if ticket.submit_ts > 0 and enq is not None:
+            router["replica_queue_s"] = max(enq - ticket.submit_ts, 0.0)
+        term = getattr(tr, "terminal_ts", None)
+        if term is not None and ticket.done_ts > 0:
+            router["finish_s"] = max(ticket.done_ts - term, 0.0)
+        base["router"] = router
+        return base
 
     def reap_tickets(self, older_than_s: float | None = None) -> int:
         """Drop tickets whose terminal result has been readable for at
@@ -1194,7 +1285,8 @@ class RouterServer:
         body = {"rid": ticket.rid, "status": res.status,
                 "tokens": list(res),
                 "replica": ticket.replica,
-                "failovers": ticket.failovers}
+                "failovers": ticket.failovers,
+                "trace": self._merged_trace(ticket)}
         if ticket.shed is not None:
             body["shed"] = ticket.shed
         if res.error is not None:
@@ -1281,6 +1373,17 @@ class RouterServer:
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
                 ticket.done_ts = time.monotonic()
+            self.metrics.histogram("router.e2e_s").observe(
+                ticket.done_ts - ticket.recv_ts)
+            self.metrics.histogram("router.failover_hops").observe(
+                float(ticket.failovers))
+            tr = getattr(res, "trace", None)
+            if (ticket.submit_ts > 0
+                    and getattr(tr, "enqueue_ts", None) is not None):
+                # Same-process monotonic clocks: the engine enqueue
+                # stamp joins the router submit stamp directly.
+                self.metrics.histogram("router.replica_queue_s").observe(
+                    max(tr.enqueue_ts - ticket.submit_ts, 0.0))
             ticket.done.set()
             if ticket.journaled:
                 self._journal_terminal(ticket, res)

@@ -1,0 +1,369 @@
+"""Open-loop load harness (horovod_tpu/loadgen.py).
+
+Three oracles pin the harness, all seed-deterministic and
+virtual-clocked — no sleeps in any assertion path:
+
+1. *Schedules are pure*: every arrival process and request mix is a
+   pure function of (seed, rate, duration) — generate twice, get
+   bit-identical times, prompts, and digests; Bursty really is
+   burstier than Poisson at the same offered rate.
+2. *Open loop means open loop*: the driver fires every scheduled
+   arrival even while earlier requests are still in flight, and a
+   poison blend terminates ``REJECTED`` without hurting neighbours.
+3. *Attribution tiles e2e*: the per-phase split joined from router
+   spans + engine traces sums to the client-observed latency
+   (coverage ~= 1), the sweep's knee/percentile schema is stable, and
+   the ``tools/load_report.py --compare`` gate exits 1 on regression.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from horovod_tpu.loadgen import (
+    ATTR_PHASES, Arrival, Bursty, DEFAULT_TENANTS, FixedRate, Poisson,
+    RequestMix, TenantSpec, VirtualClock, WallClock, attribute,
+    build_schedule, measure_saturation, percentile, resolve_process,
+    run_open_loop, schedule_digest, summarize_rung,
+)
+from horovod_tpu.models import llama
+from horovod_tpu.router import RouterServer
+from horovod_tpu.serving import OK, REJECTED, Request
+from horovod_tpu.serving_scheduler import ServeEngine
+
+pytestmark = pytest.mark.load
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = llama.llama_tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    return cfg, params
+
+
+def _engines(params, cfg, n, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("prefix_cache", True)
+    return [ServeEngine(params, cfg, **kw) for _ in range(n)]
+
+
+# -- arrival processes: pure, seeded, no engine ------------------------------
+
+
+def test_fixed_rate_is_evenly_spaced():
+    ts = FixedRate(10.0).times(1.0)
+    assert len(ts) == 10
+    assert ts == tuple(i / 10.0 for i in range(10))
+    assert all(b > a for a, b in zip(ts, ts[1:]))
+
+
+def test_poisson_is_deterministic_and_rate_accurate():
+    a = Poisson(50.0, seed=7).times(20.0)
+    b = Poisson(50.0, seed=7).times(20.0)
+    assert a == b                       # pure function of (rate, seed)
+    assert a != Poisson(50.0, seed=8).times(20.0)
+    assert all(0.0 <= t < 20.0 for t in a)
+    assert all(y > x for x, y in zip(a, a[1:]))
+    assert len(a) == pytest.approx(50.0 * 20.0, rel=0.15)
+
+
+def test_bursty_same_mean_rate_but_clumpier():
+    dur, rate = 60.0, 40.0
+    p = Poisson(rate, seed=3).times(dur)
+    q = Bursty(rate, seed=3).times(dur)
+    assert q == Bursty(rate, seed=3).times(dur)
+    assert len(q) == pytest.approx(rate * dur, rel=0.2)
+
+    def dispersion(ts, bin_s=0.25):
+        counts = [0] * int(dur / bin_s)
+        for t in ts:
+            counts[min(int(t / bin_s), len(counts) - 1)] += 1
+        m = statistics.mean(counts)
+        return statistics.pvariance(counts) / m if m else 0.0
+
+    # Poisson bin counts have dispersion ~1; the Markov-modulated
+    # process concentrates arrivals in burst slots.
+    assert dispersion(q) > dispersion(p) + 0.5
+
+
+def test_resolve_process_names_and_errors():
+    assert isinstance(resolve_process("poisson", 5.0, 1), Poisson)
+    assert isinstance(resolve_process("bursty", 5.0, 1), Bursty)
+    assert isinstance(resolve_process("fixed", 5.0, 1), FixedRate)
+    inst = Poisson(2.0, 0)
+    assert resolve_process(inst, 99.0) is inst   # passthrough
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        resolve_process("lognormal", 5.0, 1)
+    with pytest.raises(ValueError):
+        Poisson(0.0)
+
+
+# -- request mixes + schedules ----------------------------------------------
+
+
+def test_schedule_is_bit_reproducible():
+    mix = RequestMix(DEFAULT_TENANTS, seed=5)
+    proc = Poisson(30.0, seed=9)
+    s1 = build_schedule(proc, mix, 2.0, seed=9)
+    s2 = build_schedule(Poisson(30.0, seed=9),
+                        RequestMix(DEFAULT_TENANTS, seed=5), 2.0, seed=9)
+    assert schedule_digest(s1) == schedule_digest(s2)
+    assert [a.req.prompt for a in s1] == [a.req.prompt for a in s2]
+    assert schedule_digest(s1) != schedule_digest(
+        build_schedule(proc, mix, 2.0, seed=10))
+
+
+def test_mix_respects_weights_prefixes_and_slos():
+    tenants = (TenantSpec("hot", weight=3.0, prompt_len=(2, 4),
+                          new_tokens=(2, 4), shared_prefixes=3,
+                          prefix_len=8, slo_s=1.5),
+               TenantSpec("cold", weight=1.0, prompt_len=(5, 9),
+                          new_tokens=(2, 4)))
+    mix = RequestMix(tenants, seed=2)
+    sched = build_schedule(FixedRate(400.0), mix, 1.0, seed=2)
+    hot = [a for a in sched if a.tenant == "hot"]
+    cold = [a for a in sched if a.tenant == "cold"]
+    assert len(hot) / len(sched) == pytest.approx(0.75, abs=0.08)
+    # Every hot prompt starts with one of exactly 3 corpus prefixes;
+    # the suffix varies per request.
+    heads = {tuple(a.req.prompt[:8]) for a in hot}
+    assert len(heads) == 3
+    assert all(a.req.slo_s == 1.5 for a in hot)
+    assert all(a.req.slo_s is None for a in cold)
+    assert all(8 + 2 <= len(a.req.prompt) <= 8 + 4 for a in hot)
+    assert all(5 <= len(a.req.prompt) <= 9 for a in cold)
+
+
+def test_poison_blend_marks_malformed_requests():
+    tenants = (TenantSpec("risky", poison=0.5, prompt_len=(2, 4),
+                          new_tokens=(2, 3)),)
+    sched = build_schedule(FixedRate(200.0), RequestMix(tenants, seed=4),
+                           1.0, seed=4)
+    poisoned = [a for a in sched if a.poison]
+    assert 0.3 < len(poisoned) / len(sched) < 0.7
+    assert all(a.req.prompt == [] for a in poisoned)
+    assert all(a.req.prompt for a in sched if not a.poison)
+
+
+# -- clocks + exact percentiles ---------------------------------------------
+
+
+def test_virtual_clock_never_sleeps():
+    clk = VirtualClock()
+    clk.start()
+    t0 = time.monotonic()
+    for i in range(1000):
+        clk.sleep_until(i * 10.0)
+    assert clk.now() == 9990.0
+    clk.sleep_until(5.0)                # never goes backwards
+    assert clk.now() == 9990.0
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_wall_clock_sleeps_to_offset():
+    clk = WallClock()
+    clk.start()
+    t0 = time.monotonic()
+    clk.sleep_until(0.05)
+    assert time.monotonic() - t0 >= 0.045
+    assert clk.now() >= 0.05
+
+
+def test_percentile_exact_samples():
+    assert percentile([], 0.99) == 0.0
+    assert percentile([7.0], 0.5) == 7.0
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(vals, 0.0) == 1.0
+    assert percentile(vals, 1.0) == 4.0
+    assert percentile(vals, 0.5) == pytest.approx(2.5)
+    assert percentile(list(range(101)), 0.99) == pytest.approx(99.0)
+
+
+# -- open-loop driver against a real routed fleet ----------------------------
+
+
+def test_open_loop_drive_traces_poison_and_reply_shape(world):
+    """One 2-replica fleet, three oracles: (a) the driver joins every
+    record to a phase split that tiles the client e2e; (b) a poison
+    blend is contained to its tenant; (c) the ``/v1/generate`` reply
+    body carries the merged trace (the satellite contract) and the
+    ``router.*`` span histograms observed every request."""
+    cfg, params = world
+    engines = _engines(params, cfg, 2)
+    router = RouterServer(engines, policy="least_loaded")
+    try:
+        mix = RequestMix(DEFAULT_TENANTS, seed=1, vocab_hi=60)
+        sched = build_schedule(Poisson(40.0, seed=1), mix, 0.25, seed=1)
+        records = run_open_loop(router, sched, clock=VirtualClock(),
+                                timeout_s=60.0)
+        assert len(records) == len(sched)
+        assert all(r["status"] == OK for r in records)
+        for r in records:
+            assert set(r["attr"]) == set(ATTR_PHASES)
+            tiled = sum(v for v in r["attr"].values() if v is not None)
+            assert tiled == pytest.approx(r["e2e_s"], rel=0.05)
+            assert r["ttft_s"] is not None and r["ttft_s"] <= r["e2e_s"]
+        summary = attribute(records)
+        assert summary["n"] == len(records)
+        assert summary["coverage"] == pytest.approx(1.0, abs=0.05)
+
+        # poison blend on the same fleet: REJECTED, no collateral
+        tenants = (TenantSpec("ok", weight=1.0, prompt_len=(2, 5),
+                              new_tokens=(2, 4)),
+                   TenantSpec("bad", weight=1.0, poison=1.0),)
+        sched2 = build_schedule(FixedRate(40.0), RequestMix(tenants, 3),
+                                0.25, seed=3)
+        by: dict[str, list] = {}
+        for r in run_open_loop(router, sched2, clock=VirtualClock(),
+                               timeout_s=60.0):
+            by.setdefault(r["tenant"], []).append(r)
+        assert all(r["status"] == REJECTED for r in by["bad"])
+        assert all(r["status"] == OK for r in by["ok"])
+
+        # satellite: the HTTP reply body carries the merged trace
+        code, body = router.handle_generate(
+            Request(prompt=[5, 6, 7], max_new_tokens=3))
+        assert code == 200 and body["status"] == OK
+        tr = body["trace"]
+        rt = tr["router"]
+        assert rt["failovers"] == 0 and rt["shed"] is None
+        assert rt["accept_to_submit_s"] >= 0.0
+        assert rt["route_decision_s"] >= 0.0
+        assert rt["e2e_s"] >= tr["ttft_s"] >= 0.0
+        assert rt["replica_queue_s"] >= 0.0
+        assert rt["recv_ts"] <= rt["submit_ts"] <= rt["done_ts"]
+        json.dumps(body)                # wire-serializable
+
+        # request_trace reads the same merged dict programmatically
+        rid = router.route(Request(prompt=[9, 8, 7], max_new_tokens=2))
+        assert router.result(rid, timeout=60.0) is not None
+        assert router.request_trace(rid)["status"] == OK
+        with pytest.raises(KeyError):
+            router.request_trace(rid + 999)
+        hists = router.metrics.snapshot()["histograms"]
+        for name in ("router.route_decision_s", "router.admission_s",
+                     "router.journal_append_s", "router.e2e_s",
+                     "router.failover_hops", "router.replica_queue_s"):
+            assert name in hists, name
+        for name in ("router.route_decision_s", "router.admission_s",
+                     "router.e2e_s", "router.failover_hops",
+                     "router.replica_queue_s"):
+            assert hists[name]["count"] >= 1, name
+    finally:
+        router.stop()
+
+
+# -- the saturation sweep ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sweep_pair(world):
+    """Two identical 2-rung sweeps (the reproducibility witness),
+    shared by every sweep-consuming test — the engines compile once."""
+    cfg, params = world
+
+    def _sweep():
+        return measure_saturation(
+            params, cfg, seed=6, ladder=(16.0, 96.0), duration_s=0.2,
+            n_replicas=2, n_slots=2, chunk=8, clock=VirtualClock(),
+            timeout_s=120.0)
+
+    return _sweep(), _sweep()
+
+
+def test_measure_saturation_schema_and_reproducibility(sweep_pair):
+    r1, r2 = sweep_pair
+    assert [x["schedule_digest"] for x in r1["rungs"]] == \
+        [x["schedule_digest"] for x in r2["rungs"]]
+    assert [x["n"] for x in r1["rungs"]] == [x["n"] for x in r2["rungs"]]
+    assert r1["serve_load_rungs"] == 2
+    assert r1["serve_load_requests"] == sum(x["n"] for x in r1["rungs"])
+    assert 0 <= r1["knee_index"] < 2
+    knee = r1["rungs"][r1["knee_index"]]
+    assert r1["serve_load_knee_rps"] == knee["offered_rps"]
+    assert knee["goodput_rps"] == max(
+        x["goodput_rps"] for x in r1["rungs"])
+    for rung in r1["rungs"]:
+        assert rung["ok_rate"] == 1.0
+        assert set(rung["attribution"]["phases"]) == set(ATTR_PHASES)
+    # attribution explains the e2e at the knee (acceptance: >= 0.95 on
+    # the real sweep; leave headroom for CI jitter on 2 tiny rungs)
+    assert r1["serve_load_attr_coverage_knee"] >= 0.9
+    json.dumps(r1)                      # report is a pure-JSON artifact
+
+
+def test_rung_seeds_differ_per_rung_and_per_sweep_seed(sweep_pair):
+    r1, _ = sweep_pair
+    digests = [x["schedule_digest"] for x in r1["rungs"]]
+    assert len(set(digests)) == len(digests)    # rungs get fresh seeds
+    mix = RequestMix(DEFAULT_TENANTS, 6)
+    # the rung-0 derivation with a different sweep seed changes the
+    # workload (pure-schedule check; no engines needed)
+    s6 = build_schedule(Poisson(16.0, 6 * 8191 + 1000003), mix, 0.2,
+                        6 * 8191 + 1000003)
+    s7 = build_schedule(Poisson(16.0, 7 * 8191 + 1000003), mix, 0.2,
+                        7 * 8191 + 1000003)
+    assert digests[0] == schedule_digest(s6)
+    assert schedule_digest(s6) != schedule_digest(s7)
+
+
+def test_summarize_rung_counts_lost_as_timeout():
+    recs = [
+        {"status": OK, "good": True, "e2e_s": 0.05, "ttft_s": 0.01,
+         "tpot_s": 0.002, "sched_t": 0.0, "n_tokens": 4, "attr": None},
+        {"status": "LOST", "good": False, "e2e_s": None, "ttft_s": None,
+         "tpot_s": None, "sched_t": 0.1, "n_tokens": 0, "attr": None},
+    ]
+    rung = summarize_rung(recs, offered_rps=2.0, duration_s=1.0)
+    assert rung["timeout_rate"] == 0.5
+    assert rung["ok_rate"] == 0.5
+    assert rung["p99_ttft_s"] == 0.01   # single sample
+
+
+# -- tools/load_report.py: render + the --compare gate -----------------------
+
+
+def test_load_report_render_and_compare_gate(sweep_pair, tmp_path, capsys):
+    from tools.load_report import compare_reports, load_report, main, render
+    report, _ = sweep_pair
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(report))
+    text = render(load_report(str(old)))
+    assert "saturation sweep" in text and "<< knee" in text
+    for phase in ATTR_PHASES:
+        assert phase in text
+
+    assert main([str(old)]) == 0
+    capsys.readouterr()
+    # identical reports: gate passes
+    assert main(["--compare", str(old), str(old)]) == 0
+    capsys.readouterr()
+
+    worse = json.loads(json.dumps(report))
+    worse["serve_load_knee_goodput_rps"] *= 0.5
+    for rung in worse["rungs"]:
+        rung["p99_ttft_s"] = rung["p99_ttft_s"] * 3 + 0.05
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(worse))
+    rows = compare_reports(report, worse)
+    assert any(r["regressed"] for r in rows)
+    assert main(["--compare", str(old), str(new)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    # improvement is not a regression
+    assert main(["--compare", str(new), str(old)]) == 0
+    capsys.readouterr()

@@ -38,7 +38,7 @@ from horovod_tpu import metrics as metrics_mod
 from horovod_tpu.faults import FaultRegistry
 from horovod_tpu.metrics import (
     Counter, EventLog, Histogram, MetricsRegistry, NullRegistry, Trace,
-    log_bucket_bounds,
+    log_bucket_bounds, percentile_from_buckets,
 )
 from horovod_tpu.models import llama
 from horovod_tpu.serving import (
@@ -124,6 +124,35 @@ def test_histogram_empty_and_bad_args():
         h.percentile(1.5)
     with pytest.raises(ValueError):
         Histogram("h", threading.Lock(), bounds=(2.0, 1.0))
+
+
+def test_percentile_from_buckets_edge_cases():
+    """The shared quantile kernel (Histogram and the fleet-merge path
+    both call it): empty window, single sample, exact bucket-boundary
+    mass, and the overflow bucket all resolve without bucket-edge
+    artifacts."""
+    bounds = (1.0, 2.0, 4.0)
+    empty = [0, 0, 0, 0]
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert percentile_from_buckets(bounds, empty, 0, 0.0, 0.0, q) == 0.0
+    # single sample: the mn/mx clamp reports the true value at every q
+    one = [0, 1, 0, 0]
+    for q in (0.0, 0.5, 1.0):
+        assert percentile_from_buckets(bounds, one, 1, 1.7, 1.7, q) == 1.7
+    # exact-boundary mass: samples all == 2.0 land in the (1, 2]
+    # bucket; interpolation clamps into [mn, mx] == [2, 2]
+    edge = [0, 4, 0, 0]
+    for q in (0.25, 0.5, 0.75, 1.0):
+        assert percentile_from_buckets(bounds, edge, 4, 2.0, 2.0, q) == 2.0
+    # q=0 resolves to the first occupied bucket's floor, clamped up to
+    # mn; q=1 interpolates to the bucket ceiling, clamped down to mx
+    spread = [2, 2, 0, 0]
+    assert percentile_from_buckets(bounds, spread, 4, 0.5, 1.5, 0.0) == 0.5
+    assert percentile_from_buckets(bounds, spread, 4, 0.5, 1.5, 1.0) == 1.5
+    # mass only in the overflow bucket: ceiling is the observed max
+    over = [0, 0, 0, 3]
+    assert percentile_from_buckets(bounds, over, 3, 9.0, 30.0, 1.0) == 30.0
+    assert percentile_from_buckets(bounds, over, 3, 9.0, 30.0, 0.01) == 9.0
 
 
 def test_registry_get_or_create_and_type_conflicts():
