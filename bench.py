@@ -520,6 +520,11 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
         # lives in.
         "serve_profiler_overhead_pct": round(
             r["serve_profiler_overhead_pct"], 2),
+        # The health plane priced at a 20 Hz sampling cadence (20x the
+        # shipping default): sampler + alert evaluation riding step(),
+        # bound < 2 % like the monitor arm.
+        "serve_health_overhead_pct": round(
+            r["serve_health_overhead_pct"], 2),
         "serve_phase_pct": {k: round(v, 1)
                             for k, v in r["serve_phase_pct"].items()},
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"

@@ -29,6 +29,11 @@ router+supervisor fleet serving a canned workload, then checks the
   invisible, postmortems are fiction.
 * ``healed`` — after the storm, every replica a kill took down is
   routable again (the supervisor respawned it within its budget).
+* ``alerts_covered`` (``alert_oracle=True`` campaigns) — the health
+  plane saw the storm: every immediate alert rule whose condition ever
+  held fired, every fired alert resolved after heal, and kills tripped
+  ``replica_death``.  Alerting that misses a storm it watched is a
+  broken pager.
 
 :func:`soak` repeats campaigns with consecutive seeds until a
 wall-clock budget runs out (the long-haul mode); :func:`compare_campaigns`
@@ -170,36 +175,72 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
                  n_slots: int = 2, max_len: int = 64, chunk: int = 8,
                  backoff_s: float = 0.01, max_restarts: int = 5,
                  event_log: str | None = None,
-                 timeout_s: float = 300.0) -> dict:
+                 timeout_s: float = 300.0,
+                 extra_rules: Sequence[ChaosRule] = (),
+                 slo_window: int = 8,
+                 sample_s: float = 0.005,
+                 alert_time_scale: float = 0.01,
+                 recovery_waves: int = 0,
+                 alert_oracle: bool = False,
+                 alert_drain_s: float = 10.0) -> dict:
     """One seeded chaos campaign; returns the oracle report (see the
     module docstring for the oracles).  ``report["ok"]`` is the AND of
-    every oracle — the smoke test and the soak loop key off it."""
+    every oracle — the smoke test and the soak loop key off it.
+
+    The campaign carries the health plane: a
+    :class:`~horovod_tpu.timeseries.MetricsSampler` (``sample_s``) and
+    an :class:`~horovod_tpu.alerts.AlertManager` whose production rule
+    windows are compressed by ``alert_time_scale`` ride the router
+    poller, so every report includes an ``alerts`` section and the
+    event log carries the ``alert.*`` transitions.  With
+    ``alert_oracle=True`` the campaign additionally serves
+    ``recovery_waves`` clean waves after heal (their prompts repeat the
+    storm workload, so the fault-free reference covers them), drains
+    until no rule is firing (bounded by ``alert_drain_s``), and adds
+    the ``alerts_covered`` oracle: every zero-``pending_s`` rule whose
+    condition ever held must have FIRED, every fired rule must have
+    RESOLVED, and a campaign with kills must have fired
+    ``replica_death`` — alert coverage as a tested invariant.
+    ``extra_rules`` appends deterministic
+    :class:`ChaosRule`\\ s to the seeded schedule (the acceptance test
+    forces a goodput dip with a consecutive-prefill-fault rule)."""
+    from horovod_tpu import alerts as alerts_mod
+    from horovod_tpu import timeseries as timeseries_mod
     from horovod_tpu.serving_scheduler import ServeEngine
 
     workload = _workload(n_groups, waves)
+    recovery = (_workload(n_groups, recovery_waves)
+                if recovery_waves else [])
     names = [f"replica{i}" for i in range(n_replicas)]
     schedule = ChaosSchedule.generate(
         seed, replica_names=names, n_faults=n_faults, n_kills=n_kills)
 
     # Fault-free reference: one solo engine (routing never changes
     # tokens — the router bench asserts that — so a single engine's
-    # greedy output IS the fleet's fault-free output).
+    # greedy output IS the fleet's fault-free output).  Covers the
+    # recovery waves too — same prompt generator, so OK bits must
+    # match there as well.
     ref_engine = ServeEngine(params, cfg, n_slots=n_slots,
                              max_len=max_len, chunk=chunk,
                              prefix_cache=True, monitor=False,
                              metrics=metrics_mod.NULL)
-    reference = ref_engine.run(workload)
+    reference = ref_engine.run(workload + recovery)
 
     # The chaos fleet: engines, registry, storm, supervisor, journal-
     # free router (journal determinism has its own tests; the campaign
-    # exercises engine faults + kills + respawn).
+    # exercises engine faults + kills + respawn).  A small SLO window
+    # lets fleet goodput both sag under the storm and recover within
+    # the recovery waves.
     fr = faults_mod.FaultRegistry()
     schedule.arm(fr)
+    for rule in extra_rules:
+        rule.arm(fr)
     reg = metrics_mod.MetricsRegistry()
     engines = [ServeEngine(params, cfg, n_slots=n_slots,
                            max_len=max_len, chunk=chunk,
                            prefix_cache=True, monitor=False,
-                           faults=fr, metrics=reg)
+                           faults=fr, metrics=reg,
+                           slo_window=slo_window, sampler=False)
                for _ in range(n_replicas)]
     if event_log is None:
         event_log = os.path.join(
@@ -208,27 +249,34 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
     prior_log = os.environ.get("HVD_TPU_EVENT_LOG")
     os.environ["HVD_TPU_EVENT_LOG"] = event_log
 
+    sampler = timeseries_mod.MetricsSampler(
+        reg, sample_s=sample_s, raw_points=4096)
+    alerts = alerts_mod.AlertManager(sampler, registry=reg,
+                                     time_scale=alert_time_scale)
     router = RouterServer(engines, policy="round_robin", registry=reg,
-                          faults=fr)
+                          faults=fr, sampler=sampler, alerts=alerts)
     ReplicaSupervisor(router, max_restarts=max_restarts,
                       backoff_s=backoff_s, warm_prefixes=4)
     samples: list[dict] = []
     results: list[Any] = []
     deadline = time.monotonic() + timeout_s
+
+    def _serve(wave: list[Request]) -> None:
+        rids = [router.route(r) for r in wave]
+        for rid in rids:
+            while True:
+                res = router.result(rid, timeout=0.05)
+                if res is not None:
+                    results.append(res)
+                    break
+                router.poll_now()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"chaos campaign stalled (seed={seed})")
+
     try:
         for w in range(waves):
-            wave = workload[w * n_groups:(w + 1) * n_groups]
-            rids = [router.route(r) for r in wave]
-            for rid in rids:
-                while True:
-                    res = router.result(rid, timeout=0.05)
-                    if res is not None:
-                        results.append(res)
-                        break
-                    router.poll_now()
-                    if time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"chaos campaign stalled (seed={seed})")
+            _serve(workload[w * n_groups:(w + 1) * n_groups])
             samples.append(dict(reg.snapshot()["counters"]))
         # Heal window: give the supervisor polls until every replica
         # is routable again (backoff is tiny; this is hit-bounded by
@@ -239,6 +287,30 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
             if health["healthy"] == n_replicas:
                 break
             time.sleep(backoff_s)
+        # Clean recovery traffic: storm-window SLO failures only age
+        # out of the per-engine goodput windows when fresh terminals
+        # displace them — a gauge nobody writes never recovers.  Under
+        # ``alert_oracle`` the waves interleave with the alert drain:
+        # histogram-backed rules (drift) need fresh deltas while their
+        # hysteresis clears, because a quiet histogram is "no data"
+        # and no-data deliberately holds alert state.
+        served = 0
+        if alert_oracle:
+            # Alert drain: keep polling (sampler + rules keep ticking)
+            # until every firing rule has cleared its hysteresis, so
+            # "resolved after heal" is observed, not assumed.
+            drain_deadline = min(deadline,
+                                 time.monotonic() + alert_drain_s)
+            while (alerts.firing()
+                   and time.monotonic() < drain_deadline):
+                if served < recovery_waves:
+                    _serve(recovery[served * n_groups:
+                                    (served + 1) * n_groups])
+                    served += 1
+                router.poll_now()
+                time.sleep(backoff_s)
+        for w in range(served, recovery_waves):
+            _serve(recovery[w * n_groups:(w + 1) * n_groups])
         samples.append(dict(reg.snapshot()["counters"]))
         router.reap_tickets(0)
         leaked_tickets = router.memory_report()["tickets"]
@@ -269,12 +341,24 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
               if e.get("kind") == "fault"]
     missing = [f for f in fired if (f[0], f[1], f[2]) not in logged]
     regressed = _counters_regressed(samples)
-    n_ok = sum(1 for r in results if r.status == OK)
+    storm_results = results[:len(workload)]
+    n_ok = sum(1 for r in storm_results if r.status == OK)
     mismatches = [i for i, (res, ref) in enumerate(zip(results,
                                                        reference))
                   if res.status == OK and list(res) != list(ref)]
     counters = samples[-1] if samples else {}
     kills_fired = sum(1 for s, _k, _h in fired if s == KILL_SITE)
+
+    alert_states = alerts.states()
+    immediate = {r["name"] for r in alerts.rules
+                 if not float(r.get("pending_s", 0))}
+    ever_true = {n for n, st in alert_states.items()
+                 if st["ever_true"]}
+    fired_rules = {n for n, st in alert_states.items()
+                   if st["fired"]}
+    resolved_rules = {n for n, st in alert_states.items()
+                      if st["resolved"]}
+    still_firing = alerts.firing()
 
     oracles = {
         "bit_identical": not mismatches,
@@ -284,6 +368,17 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
         "faults_logged": not missing,
         "healed": health["healthy"] == n_replicas,
     }
+    if alert_oracle:
+        # Alert coverage: every immediate (zero-pending) rule whose
+        # condition was ever observed true must have fired; every
+        # fired rule must have resolved (nothing still firing after
+        # the drain); and a storm with kills must have tripped
+        # replica_death.
+        oracles["alerts_covered"] = (
+            (ever_true & immediate) <= fired_rules
+            and fired_rules <= resolved_rules
+            and not still_firing
+            and (kills_fired == 0 or "replica_death" in fired_rules))
     return {
         "seed": seed,
         "schedule": schedule.to_json(),
@@ -303,6 +398,13 @@ def run_campaign(params: dict, cfg: Any, *, seed: int = 0,
         "counter_regressions": regressed,
         "unlogged_faults": [list(f) for f in missing],
         "mismatched_requests": mismatches,
+        "alerts": {
+            "fired": sorted(fired_rules),
+            "resolved": sorted(resolved_rules),
+            "ever_true": sorted(ever_true),
+            "still_firing": still_firing,
+            "transitions": len(alerts.report()["history"]),
+        },
         "event_log": event_log,
         "oracles": oracles,
         "ok": all(oracles.values()),

@@ -66,12 +66,17 @@ ENV_KNOBS = (
      "Depth-1 dispatch serialization: auto (CPU only), on, or off."),
     ("HOROVOD_TPU_X64", "0",
      "Enable 64-bit jax types for the torch-compat surface."),
+    ("HVD_TPU_ALERTS", "1",
+     "Evaluate ALERT_RULES over the sampled series (0 = off)."),
     ("HVD_TPU_BENCH_CACHE", "",
      "Directory for cached benchmark baselines (default: repo-local)."),
     ("HVD_TPU_DRAFT_K", "4",
      "Draft tokens proposed per slot per tick when speculation is on."),
     ("HVD_TPU_EVENT_LOG", "",
      "JSONL request-lifecycle event-log output path."),
+    ("HVD_TPU_EVENT_LOG_MAX_MB", "",
+     "Rotate the event log past this many MB, keeping one .1 "
+     "generation (unset = unbounded)."),
     ("HVD_TPU_FLASH_BWD", "pallas",
      "Flash-attention backward implementation: pallas or blockwise."),
     ("HVD_TPU_LOAD_DURATION_S", "1.0",
@@ -118,6 +123,8 @@ ENV_KNOBS = (
      "Consecutive failed probes before an HTTP replica is marked dead."),
     ("HVD_TPU_ROUTER_TICKET_TTL_S", "600",
      "Seconds a finished router ticket stays readable before reaping."),
+    ("HVD_TPU_SAMPLE_S", "1.0",
+     "Seconds between time-series samples of the registry (<= 0 = off)."),
     ("HVD_TPU_SCHED_POLICY", "fifo",
      "ServeEngine scheduler policy: fifo, priority, or edf."),
     ("HVD_TPU_SLO_E2E_S", "0",

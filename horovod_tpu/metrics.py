@@ -189,6 +189,20 @@ METRIC_HELP: dict[str, str] = {
     # monitor.* — the cross-rank observability layer itself
     "monitor.scrapes": "HTTP requests served by the /metrics exporter",
     "monitor.aggregations": "Cross-rank aggregate_snapshots() rounds completed",
+    "monitor.scrape_s": "Seconds serving one exporter request, per endpoint (monitor.scrape_s.<endpoint>)",
+    "monitor.scrape_errors": "Exporter requests that raised or returned 5xx, per endpoint",
+    # ts.* — the in-process time-series sampler (horovod_tpu.timeseries)
+    "ts.samples": "Registry snapshots folded into the ring-buffer series",
+    "ts.series": "Distinct metric series held across all downsample tiers",
+    # alert.* — declarative rule evaluation (horovod_tpu.alerts)
+    "alert.evals": "ALERT_RULES evaluation passes executed",
+    "alert.fired": "Alert transitions into the firing state",
+    "alert.resolved": "Firing alerts that resolved after sustained recovery",
+    "alert.firing": "Rules currently in the firing state",
+    "alert.pending": "Rules currently pending (condition true, not yet sustained)",
+    # advisor.* — the capacity advisor (horovod_tpu.alerts)
+    "advisor.recommendations": "Capacity recommendation records emitted",
+    "advisor.target_delta": "Signed replica delta of the last recommendation (+grow/-shrink)",
     # router.* — the multi-replica front door (horovod_tpu.router)
     "router.requests": "Requests received at the router front door",
     "router.routed.round_robin": "Requests placed by the round_robin policy",
@@ -458,24 +472,45 @@ def current_rank() -> int:
 
 
 class EventLog:
-    """Append-only JSONL event sink: one JSON object per line, each with
-    ``ts`` (wall-clock ``time.time()``) and ``kind`` plus the emitter's
+    """Append-only JSONL event sink: one JSON object per line, each
+    stamped with the ``(wall_s, mono_s)`` clock pair (``ts`` is the
+    wall-clock half, kept under its original key; ``mono_s`` is
+    ``time.monotonic()`` so cross-rank tools can align on monotonic
+    deltas when wall clocks skew) plus ``kind`` and the emitter's
     fields.  Flushed per line — a crashed process leaves a readable log
     up to its last event (the postmortem property the engine watchdog
-    counts on).  Thread-safe."""
+    counts on).  Thread-safe.
 
-    _GUARDED_BY_LOCK = ("_file",)
+    The sink is size-bounded: past ``max_mb`` (default from
+    ``HVD_TPU_EVENT_LOG_MAX_MB``; unset/0 = unbounded) the file rotates
+    to ``<path>.1``, keeping one generation.  :meth:`read` spans the
+    rotation boundary and stays torn-line tolerant in both
+    generations."""
 
-    def __init__(self, path: str):
+    _GUARDED_BY_LOCK = ("_file", "_bytes")
+
+    def __init__(self, path: str, max_mb: float | None = None):
         self.path = path
+        if max_mb is None:
+            raw = os.environ.get("HVD_TPU_EVENT_LOG_MAX_MB", "")
+            try:
+                max_mb = float(raw) if raw else 0.0
+            except ValueError:
+                max_mb = 0.0
+        self.max_bytes = int(max_mb * 1024 * 1024) if max_mb > 0 else 0
         self._lock = threading.Lock()
         dirname = os.path.dirname(path)
         if dirname:
             os.makedirs(dirname, exist_ok=True)
         self._file: IO[str] | None = open(path, "a")
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
 
     def emit(self, kind: str, **fields: Any) -> None:
-        line = json.dumps({"ts": time.time(), "kind": kind,
+        line = json.dumps({"ts": time.time(),
+                           "mono_s": time.monotonic(), "kind": kind,
                            "rank": current_rank(), "pid": os.getpid(),
                            **fields})
         with self._lock:
@@ -483,6 +518,23 @@ class EventLog:
                 return
             self._file.write(line + "\n")
             self._file.flush()
+            self._bytes += len(line) + 1
+            if self.max_bytes and self._bytes > self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Roll the current file to ``<path>.1`` (replacing any prior
+        generation) and start fresh.  Best-effort: a failed rename
+        keeps appending to the oversized file rather than losing
+        events."""
+        assert self._file is not None
+        self._file.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+            self._bytes = 0
+        except OSError:
+            pass
+        self._file = open(self.path, "a")
 
     def close(self) -> None:
         with self._lock:
@@ -492,18 +544,23 @@ class EventLog:
 
     @staticmethod
     def read(path: str) -> list[dict]:
-        """Parse a JSONL event log (test/replay helper).  A torn final
-        line (writer died mid-write) is dropped, not fatal."""
+        """Parse a JSONL event log (test/replay helper), including the
+        rotated ``<path>.1`` generation when present (oldest first).
+        A torn line (writer died mid-write, or mid-rotation) is
+        dropped, not fatal."""
         out = []
-        with open(path) as f:
-            for ln in f:
-                ln = ln.strip()
-                if not ln:
-                    continue
-                try:
-                    out.append(json.loads(ln))
-                except json.JSONDecodeError:
-                    continue
+        for p in (path + ".1", path):
+            if p.endswith(".1") and not os.path.exists(p):
+                continue
+            with open(p) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        out.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        continue
         return out
 
 

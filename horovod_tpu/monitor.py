@@ -101,42 +101,85 @@ class _Handler(BaseHTTPRequestHandler):
         mon = self.server.monitor
         mon._scrapes.inc()
         path = self.path.split("?", 1)[0]
+        hist, errors = mon._scrape_obs(path.strip("/") or "root")
+        t0 = time.perf_counter()
+        failed = False
         try:
-            if path == "/metrics":
-                self._reply(200, mon.registry.to_prometheus(),
-                            "text/plain; version=0.0.4; charset=utf-8")
-            elif path == "/snapshot":
-                # With an engine attached, the engine's view — it embeds
-                # the SLO report next to the registry snapshot.
-                snap = (mon.engine.metrics_snapshot() if mon.engine
-                        is not None else mon.registry.snapshot())
-                self._reply(200, json.dumps(snap), "application/json")
-            elif path == "/healthz":
-                code, body = mon.health()
-                self._reply(code, json.dumps(body), "application/json")
-            elif path == "/state":
-                eng = mon.engine
-                if eng is None:
-                    self._reply(404, "no engine attached\n", "text/plain")
-                else:
-                    self._reply(200, eng.state_dump(),
-                                "text/plain; charset=utf-8")
-            elif path == "/profile":
-                prof = getattr(mon.engine, "prof", None)
-                if prof is None:
-                    self._reply(
-                        404, "profiling off; construct the engine with "
-                             "profile=True or set HVD_TPU_PROFILE=1\n",
-                        "text/plain")
-                else:
-                    self._reply(200, json.dumps(prof.report()),
-                                "application/json")
-            else:
-                self._reply(404, "unknown path; try /metrics /snapshot "
-                                 "/healthz /state /profile\n",
-                            "text/plain")
+            self._route(mon, path)
         except BrokenPipeError:  # scraper hung up mid-reply
             pass
+        except Exception:
+            failed = True
+            raise
+        finally:
+            hist.observe(time.perf_counter() - t0)
+            if failed:
+                errors.inc()
+
+    def _route(self, mon: "MonitorServer", path: str) -> None:
+        if path == "/metrics":
+            self._reply(200, mon.registry.to_prometheus(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/snapshot":
+            # With an engine attached, the engine's view — it embeds
+            # the SLO report next to the registry snapshot.
+            snap = (mon.engine.metrics_snapshot() if mon.engine
+                    is not None else mon.registry.snapshot())
+            self._reply(200, json.dumps(snap), "application/json")
+        elif path == "/healthz":
+            code, body = mon.health()
+            self._reply(code, json.dumps(body), "application/json")
+        elif path == "/state":
+            eng = mon.engine
+            if eng is None:
+                self._reply(404, "no engine attached\n", "text/plain")
+            else:
+                self._reply(200, eng.state_dump(),
+                            "text/plain; charset=utf-8")
+        elif path == "/profile":
+            prof = getattr(mon.engine, "prof", None)
+            if prof is None:
+                self._reply(
+                    404, "profiling off; construct the engine with "
+                         "profile=True or set HVD_TPU_PROFILE=1\n",
+                    "text/plain")
+            else:
+                self._reply(200, json.dumps(prof.report()),
+                            "application/json")
+        elif path == "/timeseries":
+            sampler = getattr(mon.engine, "sampler", None)
+            if sampler is None:
+                self._reply(
+                    404, "no sampler attached; construct the engine "
+                         "with sampler=... or set HVD_TPU_SAMPLE_S\n",
+                    "text/plain")
+            else:
+                self._reply(200, json.dumps(sampler.report()),
+                            "application/json")
+        elif path == "/alerts":
+            alerts = getattr(mon.engine, "alerts", None)
+            if alerts is None:
+                self._reply(
+                    404, "no alert manager attached; construct the "
+                         "engine with alerts=... (HVD_TPU_ALERTS)\n",
+                    "text/plain")
+            else:
+                self._reply(200, json.dumps(alerts.report()),
+                            "application/json")
+        elif path == "/advice":
+            advisor = getattr(mon.engine, "advisor", None)
+            if advisor is None:
+                self._reply(404, "no capacity advisor attached\n",
+                            "text/plain")
+            else:
+                advisor.recommend()
+                self._reply(200, json.dumps(advisor.report()),
+                            "application/json")
+        else:
+            self._reply(404, "unknown path; try /metrics /snapshot "
+                             "/healthz /state /profile /timeseries "
+                             "/alerts /advice\n",
+                        "text/plain")
 
     def log_message(self, fmt: str, *args: Any) -> None:
         pass  # scrapes must not spam the job's stderr
@@ -167,10 +210,39 @@ class MonitorServer:
         # snapshots while letting its rendered value lag one scrape.
         self._scrapes = self.registry.counter("monitor.scrapes")
         self._scrapes._gen = metrics_mod._Gen()
+        # Per-endpoint scrape self-observation on the same private-gen
+        # trick: monitor.scrape_s.<endpoint> / monitor.scrape_errors.
+        # <endpoint> stay live in snapshots without the act of scraping
+        # invalidating the rendered /metrics cache it serves.
+        self._scrape_instruments: dict[str, tuple[Any, Any]] = {}
         self._httpd = MonitorServer._Server((host, port), _Handler)
         self._httpd.monitor = self
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: threading.Thread | None = None
+
+    _SCRAPE_ENDPOINTS = frozenset(
+        {"metrics", "snapshot", "healthz", "state", "profile",
+         "timeseries", "alerts", "advice", "root"})
+
+    def _scrape_obs(self, endpoint: str) -> tuple[Any, Any]:
+        """(latency histogram, error counter) for one endpoint, created
+        on first hit with private generation cells.  Unknown paths
+        share one ``other`` family so request paths can't mint
+        unbounded metric names."""
+        if endpoint not in MonitorServer._SCRAPE_ENDPOINTS:
+            endpoint = "other"
+        pair = self._scrape_instruments.get(endpoint)
+        if pair is None:
+            hist = self.registry.histogram(
+                "monitor.scrape_s." + endpoint)
+            hist._gen = metrics_mod._Gen()
+            errors = self.registry.counter(
+                "monitor.scrape_errors." + endpoint)
+            errors._gen = metrics_mod._Gen()
+            # Benign race: both threads resolve the same registry
+            # instruments, so last-write-wins is still correct.
+            pair = self._scrape_instruments[endpoint] = (hist, errors)
+        return pair
 
     def attach_engine(self, engine: Any) -> None:
         """Point ``/healthz`` and ``/state`` at a (new) engine."""
@@ -322,12 +394,25 @@ def merge_snapshots(snaps: Iterable[dict],
             "mean": sum(vals) / len(vals),
         }
 
-    return {
+    merged = {
         "ranks": [int(r) for r in rank_ids],
         "counters": dict(sorted(counters.items())),
         "gauges": dict(sorted(gauges.items())),
         "histograms": dict(sorted(hists.items())),
     }
+
+    # Snapshots from engines with a sampler attached carry a
+    # "timeseries" section; merge those bucket-for-bucket too.  Ranks
+    # without one (older code, sampler off) just don't contribute.
+    ts_reports = [(rid, s["timeseries"]) for rid, s in
+                  zip(rank_ids, snaps)
+                  if isinstance(s.get("timeseries"), dict)]
+    if ts_reports:
+        from horovod_tpu import timeseries as timeseries_mod
+        merged["timeseries"] = timeseries_mod.merge_series(
+            [r for _, r in ts_reports],
+            ranks=[rid for rid, _ in ts_reports])
+    return merged
 
 
 def aggregate_snapshots(

@@ -795,16 +795,49 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif path == "/snapshot":
                 snap = router.metrics.snapshot()
                 snap["replicas"] = router.replicas_report()
+                if router.sampler is not None:
+                    snap["timeseries"] = router.sampler.report(
+                        points=16)
+                if router.alerts is not None:
+                    snap["alerts"] = router.alerts.report()
                 self._reply(200, json.dumps(snap), "application/json")
             elif path == "/healthz":
                 code, body = router.health()
                 self._reply(code, json.dumps(body), "application/json")
             elif path == "/state":
                 self._reply(200, router.state_dump(), "text/plain")
+            elif path == "/timeseries":
+                if router.sampler is None:
+                    self._reply(404, "no sampler attached; set "
+                                     "HVD_TPU_SAMPLE_S or pass "
+                                     "sampler=...\n", "text/plain")
+                else:
+                    self._reply(200,
+                                json.dumps(router.sampler.report()),
+                                "application/json")
+            elif path == "/alerts":
+                if router.alerts is None:
+                    self._reply(404, "no alert manager attached "
+                                     "(HVD_TPU_ALERTS)\n",
+                                "text/plain")
+                else:
+                    self._reply(200,
+                                json.dumps(router.alerts.report()),
+                                "application/json")
+            elif path == "/advice":
+                if router.advisor is None:
+                    self._reply(404, "no capacity advisor attached\n",
+                                "text/plain")
+                else:
+                    router.advisor.recommend()
+                    self._reply(200,
+                                json.dumps(router.advisor.report()),
+                                "application/json")
             else:
                 self._reply(404, "unknown path; try /v1/generate "
                                  "/replicas /snapshot /healthz "
-                                 "/metrics /state\n",
+                                 "/metrics /state /timeseries "
+                                 "/alerts /advice\n",
                             "text/plain")
         except BrokenPipeError:
             pass
@@ -880,7 +913,9 @@ class RouterServer:
                  shadow_max_paths: int = 4096,
                  journal: str | None = None,
                  journal_keys: int | None = None,
-                 drain_s: float | None = None):
+                 drain_s: float | None = None,
+                 sampler: "Any | bool | None" = None,
+                 alerts: "Any | bool | None" = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas: list[ReplicaHandle] = []
@@ -1013,6 +1048,29 @@ class RouterServer:
         # trick) so idle /metrics scrapes stay render-cached.
         self._scrapes = self.metrics.counter("monitor.scrapes")
         self._scrapes._gen = metrics_mod._Gen()
+
+        # Health plane over the router's own registry, ticked by the
+        # poller (no extra threads): sampler -> alert rules -> capacity
+        # advisor.  Same contract as ServeEngine: None = env-driven,
+        # False = off, an instance is used as-is.
+        from horovod_tpu import alerts as alerts_mod
+        from horovod_tpu import timeseries as timeseries_mod
+        if sampler is False:
+            self.sampler = None
+        elif sampler is None:
+            self.sampler = timeseries_mod.maybe_sampler(self.metrics)
+        else:
+            self.sampler = sampler
+        if alerts is False or self.sampler is None:
+            self.alerts = None
+        elif alerts is None:
+            self.alerts = alerts_mod.maybe_alerts(
+                self.sampler, self.metrics)
+        else:
+            self.alerts = alerts
+        self.advisor = (alerts_mod.CapacityAdvisor(
+            self.sampler, alerts=self.alerts, registry=self.metrics)
+            if self.sampler is not None else None)
 
         self._httpd = RouterServer._Server((host, port), _RouterHandler)
         self._httpd.router = self
@@ -1598,6 +1656,12 @@ class RouterServer:
         sup = self.supervisor
         if sup is not None:
             sup.tick()
+        # Health plane rides the poll cadence — cheap no-ops between
+        # sampling/evaluation deadlines.
+        if self.sampler is not None:
+            self.sampler.tick()
+            if self.alerts is not None:
+                self.alerts.tick()
         self.reap_tickets()
 
     def _poll_loop(self) -> None:
@@ -1650,6 +1714,15 @@ class RouterServer:
             lines.append(f"  replica {name}: "
                          f"{'DEAD' if name in dead else 'up'} "
                          f"routed={routed} inflight={infl}")
+        if self.alerts is not None:
+            arep = self.alerts.report()
+            lines.append(f"  alerts: firing={arep['firing']} "
+                         f"pending={arep['pending']} "
+                         f"transitions={len(arep['history'])}")
+        if self.advisor is not None:
+            rec = self.advisor.recommend()
+            lines.append(f"  advice: {rec['action']} n={rec['n']} "
+                         f"({rec['reason']})")
         sup = self.supervisor
         if sup is not None:
             for name, st in sorted(sup.state().items()):

@@ -132,12 +132,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from horovod_tpu import alerts as alerts_mod
 from horovod_tpu import drafting as drafting_mod
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
 from horovod_tpu import monitor as monitor_mod
 from horovod_tpu import profiler as profiler_mod
 from horovod_tpu import scheduling as scheduling_mod
+from horovod_tpu import timeseries as timeseries_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.prefix_cache import RadixPrefixCache
@@ -310,6 +312,10 @@ class ServeEngine:
                  spec: bool | None = None,
                  draft_k: int | None = None,
                  policy: "scheduling_mod.SchedulerPolicy | str | None"
+                     = None,
+                 sampler: "timeseries_mod.MetricsSampler | bool | None"
+                     = None,
+                 alerts: "alerts_mod.AlertManager | bool | None"
                      = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
@@ -381,6 +387,27 @@ class ServeEngine:
         self.slo = monitor_mod.SLOWindow(window=slo_window,
                                          slo_e2e_s=slo_e2e_s)
         self._slo_targets: dict[int, float | None] = {}
+        # Health plane: time-series sampler + alert rules, ticked from
+        # step() bookkeeping (no threads).  None = env-driven
+        # (HVD_TPU_SAMPLE_S / HVD_TPU_ALERTS), False = off, an instance
+        # is used as-is; the capacity advisor rides along whenever a
+        # sampler is live.
+        if sampler is False:
+            self.sampler = None
+        elif sampler is None:
+            self.sampler = timeseries_mod.maybe_sampler(self.metrics)
+        else:
+            self.sampler = sampler
+        if alerts is False or self.sampler is None:
+            self.alerts = None
+        elif alerts is None:
+            self.alerts = alerts_mod.maybe_alerts(
+                self.sampler, self.metrics)
+        else:
+            self.alerts = alerts
+        self.advisor = (alerts_mod.CapacityAdvisor(
+            self.sampler, alerts=self.alerts, registry=self.metrics)
+            if self.sampler is not None else None)
         # Live exporter: False = off; None = env-driven
         # (HVD_TPU_MONITOR_PORT); int = bind that port; an existing
         # MonitorServer re-attaches to this engine.
@@ -550,6 +577,15 @@ class ServeEngine:
             snap["prefix"] = self.prefix.key_digest()
         if self.prof is not None:
             snap["profile"] = self.prof.report()
+        if self.sampler is not None:
+            # Trailing points only: the full rings stay behind the
+            # /timeseries endpoint; snapshots ride merge_snapshots and
+            # state dumps, where bounded beats complete.
+            snap["timeseries"] = self.sampler.report(points=16)
+        if self.alerts is not None:
+            snap["alerts"] = self.alerts.report()
+        if self.advisor is not None:
+            snap["advice"] = self.advisor.recommend()
         return snap
 
     def memory_report(self) -> dict:
@@ -634,6 +670,16 @@ class ServeEngine:
             "  metrics=" + json.dumps(self.metrics_snapshot(),
                                       sort_keys=True),
         ]
+        if self.alerts is not None:
+            arep = self.alerts.report()
+            lines.append(
+                f"  alerts: firing={arep['firing']} "
+                f"pending={arep['pending']} "
+                f"transitions={len(arep['history'])}")
+        if self.advisor is not None:
+            rec = self.advisor.recommend()
+            lines.append(f"  advice: {rec['action']} n={rec['n']} "
+                         f"({rec['reason']})")
         bb = self._block_bytes
         lines.append(
             f"  kv bytes: block={bb} free={self.pool.free_count() * bb}"
@@ -1486,6 +1532,12 @@ class ServeEngine:
                     f"is stuck.  State:\n{self.state_dump()}")
         else:
             self._idle_steps = 0
+        # Health plane: sample the registry, then judge the series —
+        # both are cheap no-ops until their cadence elapses.
+        if self.sampler is not None:
+            self.sampler.tick()
+            if self.alerts is not None:
+                self.alerts.tick()
         self._last_step_ts = time.monotonic()
         self.step_index += 1
         if prof is not None:
@@ -1528,9 +1580,12 @@ def measure_throughput(
     (``serve_ttft_p50_ms`` .. ``serve_e2e_p99_ms``),
     ``serve_metrics_overhead_pct`` (instrumented vs null-registry pass —
     the acceptance bound for the observability layer is < 2 %),
-    ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz) and
-    ``serve_profiler_overhead_pct`` (phase profiler on — bound < 3 %) —
-    both min-of-2 passes against an adjacent min-of-2 metrics-on base,
+    ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz),
+    ``serve_profiler_overhead_pct`` (phase profiler on — bound < 3 %)
+    and ``serve_health_overhead_pct`` (time-series sampler + alert
+    evaluation in the step loop at 20 Hz — acceptance keeps it within
+    2 % of the monitor baseline) —
+    all min-of-2 passes against an adjacent min-of-2 metrics-on base,
     so inter-pass drift doesn't masquerade as overhead — with
     ``serve_phase_pct`` / ``serve_phase_mean_ms`` per-phase breakdowns,
     ``serve_goodput``
@@ -1600,7 +1655,13 @@ def measure_throughput(
     scraper.start()
     preg = metrics_mod.MetricsRegistry(event_log=None)
     prof = profiler_mod.TickProfiler(preg, timeline=eng.timeline)
+    hreg = metrics_mod.MetricsRegistry(event_log=None)
+    # 20 Hz sampling is 20x the shipping default — the health arm
+    # prices a deliberately aggressive cadence.
+    hsampler = timeseries_mod.MetricsSampler(hreg, sample_s=0.05)
+    halerts = alerts_mod.AlertManager(hsampler, registry=hreg)
     t_base = t_serve_mon = t_serve_prof = float("inf")
+    t_serve_health = float("inf")
     try:
         for _ in range(2):
             # base leg: metrics on, no exporter scrape, no profiler
@@ -1620,8 +1681,19 @@ def measure_throughput(
             eng.prof = prof
             t_serve_prof = min(t_serve_prof, _timed_pass())
             eng.prof = None
+            # health leg: time-series sampler + alert evaluation ON in
+            # the step loop (acceptance: within 2 % of the monitor
+            # baseline).
+            eng.metrics = hreg
+            eng.sampler = hsampler
+            eng.alerts = halerts
+            t_serve_health = min(t_serve_health, _timed_pass())
+            eng.sampler = None
+            eng.alerts = None
     finally:
         eng.prof = None
+        eng.sampler = None
+        eng.alerts = None
         stop_scraping.set()
         scraper.join(timeout=5)
         mon.stop()
@@ -1675,6 +1747,8 @@ def measure_throughput(
             (t_serve_mon - t_base) / t_base * 100.0,
         "serve_profiler_overhead_pct":
             (t_serve_prof - t_base) / t_base * 100.0,
+        "serve_health_overhead_pct":
+            (t_serve_health - t_base) / t_base * 100.0,
         "serve_phase_pct": {
             p: prof_report["phases"][p]["pct_of_tick"]
             for p in profiler_mod.PHASES},

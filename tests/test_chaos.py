@@ -646,6 +646,34 @@ def test_chaos_campaign_smoke(world):
     assert report["schedule"] == again.to_json()
 
 
+def test_chaos_campaign_alert_oracle(world):
+    """The health-plane acceptance campaign: a consecutive-prefill
+    fault rule exhausts retry budgets (FAILED requests -> goodput
+    dip) on a single-replica fleet with one kill.  replica_death and
+    goodput_burn_fast must FIRE during the storm and RESOLVE after
+    heal + recovery traffic — by the alerts_covered oracle and by
+    name."""
+    cfg, params = world
+    report = run_campaign(
+        params, cfg, seed=7, n_replicas=1, n_kills=1,
+        extra_rules=[ChaosRule("serve.prefill", on_hit=2, count=12)],
+        alert_oracle=True, recovery_waves=8,
+        alert_time_scale=0.005, alert_drain_s=30.0)
+    assert report["ok"], report
+    assert report["oracles"]["alerts_covered"], report["alerts"]
+    fired = set(report["alerts"]["fired"])
+    assert "replica_death" in fired
+    assert "goodput_burn_fast" in fired
+    assert fired <= set(report["alerts"]["resolved"])
+    assert not report["alerts"]["still_firing"]
+    # The storm really failed requests — that is what burned goodput.
+    assert report["ok_fraction"] < 1.0
+    # The event log carries the transitions for health_report replay.
+    kinds = {e["kind"] for e in EventLog.read(report["event_log"])}
+    assert "alert.fire" in kinds and "alert.resolve" in kinds
+    assert report["alerts"]["transitions"] >= 4
+
+
 def test_http_idempotency_and_state_endpoint(world, tmp_path):
     cfg, params = world
     router = RouterServer(_engines(params, cfg, 1),

@@ -47,6 +47,9 @@ from tools.hvdlint.checkers.hvd004_fault_sites import (  # noqa: E402
 from tools.hvdlint.checkers.hvd005_names import (  # noqa: E402
     CounterNameChecker,
 )
+from tools.hvdlint.checkers.hvd006_alert_rules import (  # noqa: E402
+    AlertRuleChecker,
+)
 
 FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
 
@@ -207,6 +210,49 @@ def test_hvd005_fires_once(tmp_path):
     assert res.active[0].symbol == "rogue.metric:no-help"
 
 
+def _alert_rule(name, **overrides):
+    rule = {"name": name, "severity": "page", "kind": "threshold",
+            "metric": "good.metric", "pending_s": 0, "clear_s": 60,
+            "help": "a synthetic rule"}
+    rule.update(overrides)
+    return rule
+
+
+def test_hvd006_clean_rule_passes(tmp_path):
+    proj = make_project(
+        tmp_path, [],
+        test_sources=['RULE = "good_rule"\n'],
+        metric_help={"good.metric": "a described metric"},
+        alert_rules=(_alert_rule("good_rule"),))
+    res = lint(proj, AlertRuleChecker)
+    assert res.active == [], [f.render() for f in res.active]
+
+
+def test_hvd006_fires_per_defect(tmp_path):
+    proj = make_project(
+        tmp_path, [],
+        test_sources=['R = "good_rule bad_kind ghost_metric half_rule"\n'],
+        metric_help={"good.metric": "a described metric"},
+        alert_rules=(
+            _alert_rule("good_rule"),
+            _alert_rule("bad_kind", kind="vibes"),
+            _alert_rule("ghost_metric", metric="ghost.metric"),
+            _alert_rule("untested_rule"),
+            "not-a-dict",
+            {"name": "half_rule", "kind": "threshold"},
+            _alert_rule("good_rule"),
+        ))
+    res = lint(proj, AlertRuleChecker)
+    assert sorted(f.symbol for f in res.active) == [
+        "bad_kind:unknown-kind",
+        "ghost_metric:unregistered-metric",
+        "good_rule:duplicate",
+        "half_rule:missing-keys",
+        "rule[4]:malformed",
+        "untested_rule:no-test-reference",
+    ], [f.render() for f in res.active]
+
+
 # ---------------------------------------------------------------------------
 # Suppressions and the baseline.
 # ---------------------------------------------------------------------------
@@ -327,9 +373,10 @@ def test_unparsable_file_is_hvd000(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_all_five_checkers_registered():
+def test_all_six_checkers_registered():
     codes = {c.code for c in all_checkers()}
-    assert codes == {"HVD001", "HVD002", "HVD003", "HVD004", "HVD005"}
+    assert codes == {"HVD001", "HVD002", "HVD003", "HVD004", "HVD005",
+                     "HVD006"}
     assert set(CODES) >= codes | {"HVD000"}
 
 

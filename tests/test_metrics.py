@@ -252,6 +252,52 @@ def test_env_event_log_is_singleton_per_path(tmp_path, monkeypatch):
     assert len(EventLog.read(path)) == 2
 
 
+def test_event_log_records_carry_clock_pair(tmp_path):
+    """Every record carries the ``(wall_s, mono_s)`` pair: ``ts`` for
+    humans, ``mono_s`` so cross-rank tools can align on monotonic
+    deltas when wall clocks skew."""
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path)
+    log.emit("serve.submit", rid=1)
+    log.emit("serve.finish", rid=1)
+    log.close()
+    first, second = EventLog.read(path)
+    for e in (first, second):
+        assert isinstance(e["ts"], float)
+        assert isinstance(e["mono_s"], float)
+    assert second["mono_s"] >= first["mono_s"]
+
+
+def test_event_log_rotates_and_read_spans_the_boundary(
+        tmp_path, monkeypatch):
+    path = str(tmp_path / "rot.jsonl")
+    log = EventLog(path, max_mb=0.0005)    # ~512 bytes per generation
+    for i in range(20):
+        log.emit("spin", i=i, pad="x" * 80)
+    log.close()
+    assert os.path.exists(path + ".1")     # one rotated generation
+    events = EventLog.read(path)
+    ids = [e["i"] for e in events]
+    # Oldest generation first, then the live file: a contiguous suffix
+    # of the emit order (older generations age out by design).
+    assert 2 <= len(ids) < 20
+    assert ids == list(range(20 - len(ids), 20))
+    # A line torn mid-rotation is dropped, not fatal, in EITHER
+    # generation.
+    with open(path + ".1", "a") as f:
+        f.write('{"ts": 1.0, "kind": "to')
+    assert [e["i"] for e in EventLog.read(path)] == ids
+    # The env knob feeds the default cap.
+    monkeypatch.setenv("HVD_TPU_EVENT_LOG_MAX_MB", "0.25")
+    log2 = EventLog(str(tmp_path / "rot2.jsonl"))
+    assert log2.max_bytes == int(0.25 * 1024 * 1024)
+    log2.close()
+    monkeypatch.setenv("HVD_TPU_EVENT_LOG_MAX_MB", "not-a-number")
+    log3 = EventLog(str(tmp_path / "rot3.jsonl"))
+    assert log3.max_bytes == 0             # tolerant parse -> unbounded
+    log3.close()
+
+
 # ---------------------------------------------------------------------------
 # Trace math.
 # ---------------------------------------------------------------------------

@@ -268,9 +268,11 @@ def test_exporter_endpoints(world):
         assert code == 200 and ctype == "application/json"
         snap = json.loads(body)
         # Engine attached → the engine's view, SLO + memory reports
-        # embedded ("profile" appears only with profiling on).
+        # embedded, plus the env-default health plane ("profile"
+        # appears only with profiling on).
         assert set(snap) == {"counters", "gauges", "histograms", "slo",
-                             "memory"}
+                             "memory", "timeseries", "alerts",
+                             "advice"}
         assert snap["counters"]["monitor.scrapes"] >= 1
         assert snap["slo"]["goodput"] == eng.slo.goodput()
         assert snap["memory"]["kv"]["block_bytes"] == eng._block_bytes
@@ -302,9 +304,72 @@ def test_exporter_no_engine_paths():
     try:
         code, _, _ = _get(mon, "/state")
         assert code == 404                     # no engine attached
+        # No engine -> no health plane either; each 404 carries a hint.
+        code, _, body = _get(mon, "/timeseries")
+        assert code == 404 and "HVD_TPU_SAMPLE_S" in body
+        code, _, body = _get(mon, "/alerts")
+        assert code == 404 and "HVD_TPU_ALERTS" in body
+        code, _, _ = _get(mon, "/advice")
+        assert code == 404
         code, _, body = _get(mon, "/healthz")
         assert code == 200 and json.loads(body)["ok"] is True
-        assert reg.counter("monitor.scrapes").value == 2
+        assert reg.counter("monitor.scrapes").value == 5
+    finally:
+        mon.stop()
+
+
+def test_exporter_health_plane_endpoints(world):
+    """/timeseries, /alerts, /advice serve the sampler/alert/advisor
+    payloads, and the per-endpoint scrape self-observation rides
+    private generation cells — scraping must never invalidate the
+    Prometheus render cache."""
+    from horovod_tpu.alerts import AlertManager, rule_names
+    from horovod_tpu.timeseries import MetricsSampler
+
+    cfg, params = world
+    reg = MetricsRegistry(event_log=None)
+    sampler = MetricsSampler(reg, sample_s=1e-9)   # sample every step
+    eng = ServeEngine(params, cfg, n_slots=2, max_len=32, chunk=8,
+                      metrics=reg, monitor=False, sampler=sampler,
+                      alerts=AlertManager(sampler, registry=reg))
+    assert all(r.status == OK for r in eng.run(_reqs()))
+    mon = MonitorServer(reg, eng, port=0).start()
+    try:
+        code, ctype, body = _get(mon, "/timeseries")
+        assert code == 200 and ctype == "application/json"
+        ts = json.loads(body)
+        assert set(ts["tiers"]) == {"raw", "10s", "60s"}
+        assert "serve.requests_completed" in ts["tiers"]["raw"]["series"]
+        code, _, body = _get(mon, "/alerts")
+        assert code == 200
+        alerts = json.loads(body)
+        assert [r["name"] for r in alerts["rules"]] == list(rule_names())
+        # A healthy all-OK run never burns goodput (kv_exhaustion MAY
+        # trip here: production-shaped windows over a sub-second run
+        # see the allocation ramp as a drain slope).
+        assert "goodput_burn_fast" not in alerts["firing"]
+        assert "replica_death" not in alerts["firing"]
+        code, _, body = _get(mon, "/advice")
+        assert code == 200
+        advice = json.loads(body)
+        assert advice["last"]["action"] in {"hold", "scale_up",
+                                            "scale_down"}
+        # /snapshot embeds the same sections for merge_snapshots.
+        snap = json.loads(_get(mon, "/snapshot")[2])
+        assert "timeseries" in snap and "alerts" in snap
+        # Scrapes self-observe per endpoint...
+        assert any(k.startswith("monitor.scrape_s.")
+                   for k in snap["histograms"])
+        assert snap["counters"].get("monitor.scrape_errors.alerts",
+                                    0) == 0
+        # ...without touching the shared render generation: two
+        # back-to-back /metrics scrapes serve the identical cached
+        # text and leave the generation untouched.
+        gen = reg._gen.n
+        text1 = _get(mon, "/metrics")[2]
+        text2 = _get(mon, "/metrics")[2]
+        assert text1 == text2
+        assert reg._gen.n == gen
     finally:
         mon.stop()
 

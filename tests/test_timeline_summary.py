@@ -270,3 +270,65 @@ def test_maybe_create_rank_template_writes_per_rank_file(tmp_path):
     assert tl is not None
     tl.close()
     assert (tmp_path / "t_0.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Monotonic cross-rank alignment.
+# ---------------------------------------------------------------------------
+
+
+def _aligned_pair():
+    """Two ranks with per-process clock origins 5000 us apart: SYNC is
+    the earliest common event, LATE drifts 20 us on rank 1, ONLY0 is
+    rank-private."""
+    r0 = [{"name": "ONLY0", "ph": "X", "pid": 0, "tid": 0,
+           "ts": 50.0, "dur": 1.0},
+          {"name": "SYNC", "ph": "B", "pid": 0, "tid": 0, "ts": 100.0},
+          {"name": "SYNC", "ph": "E", "pid": 0, "tid": 0, "ts": 110.0},
+          {"name": "LATE", "ph": "B", "pid": 0, "tid": 0, "ts": 200.0},
+          {"name": "LATE", "ph": "E", "pid": 0, "tid": 0, "ts": 210.0}]
+    r1 = [{"name": "process_name", "ph": "M", "pid": 0,
+           "args": {"name": "meta rows have no ts"}},
+          {"name": "SYNC", "ph": "B", "pid": 0, "tid": 0, "ts": 5100.0},
+          {"name": "SYNC", "ph": "E", "pid": 0, "tid": 0, "ts": 5110.0},
+          {"name": "LATE", "ph": "B", "pid": 0, "tid": 0, "ts": 5220.0},
+          {"name": "LATE", "ph": "E", "pid": 0, "tid": 0, "ts": 5230.0}]
+    return r0, r1
+
+
+def test_rank_shifts_anchor_on_first_common_event(summary_mod):
+    r0, r1 = _aligned_pair()
+    shifts = summary_mod.rank_shifts([r0, r1])
+    # Anchor is SYNC (earliest common name by latest-first-occurrence),
+    # NOT ONLY0 (not common) and not LATE (later): rank 1 shifts back
+    # by its origin offset.
+    assert shifts == [0.0, -5000.0]
+
+
+def test_rank_shifts_zero_without_a_common_event(summary_mod):
+    a = [{"name": "A", "ph": "X", "ts": 1.0, "dur": 1.0}]
+    b = [{"name": "B", "ph": "X", "ts": 9.0, "dur": 1.0}]
+    # Nothing to anchor on beats a wrong anchor: no common event (or a
+    # single trace) means zero shifts.
+    assert summary_mod.rank_shifts([a, b]) == [0.0, 0.0]
+    assert summary_mod.rank_shifts([a]) == [0.0]
+    assert summary_mod.rank_shifts([]) == []
+
+
+def test_merge_chrome_time_aligns_rank_lanes(summary_mod, tmp_path):
+    r0, r1 = _aligned_pair()
+    paths = []
+    for i, events in enumerate([r0, r1]):
+        p = tmp_path / f"rank{i}.json"
+        p.write_text(json.dumps(events))
+        paths.append(str(p))
+    merged = summary_mod.merge_chrome(paths)
+    sync = {e["pid"]: e["ts"] for e in merged
+            if e.get("ph") == "B" and e["name"] == "SYNC"}
+    late = {e["pid"]: e["ts"] for e in merged
+            if e.get("ph") == "B" and e["name"] == "LATE"}
+    # The anchor lands both ranks' SYNC on one instant; LATE keeps its
+    # genuine 20 us inter-rank drift (alignment is one shift per rank,
+    # not per-event snapping).
+    assert sync[0] == sync[1] == 100.0
+    assert late[0] == 200.0 and late[1] == 220.0

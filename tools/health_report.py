@@ -1,0 +1,237 @@
+"""Render the fleet health plane: alert timeline, firing state, advice.
+
+Two sources, one normalized timeline — the acceptance contract is that
+a live ``/alerts`` scrape and an event-log replay of the same run
+render the SAME alert history:
+
+    python tools/health_report.py --url http://localhost:9123
+    python tools/health_report.py --events /tmp/hvd-events.jsonl
+
+Live mode scrapes ``/alerts`` (MonitorServer or RouterServer — both
+serve it) plus ``/advice`` when an advisor is attached; replay mode
+reads the structured event log (rotation-aware: a ``<path>.1``
+generation is read first, torn lines are skipped) and keeps only the
+``alert.*`` transition records the AlertManager emitted.  Either way
+the result is a normalized timeline of
+``{t, rule, event, state, severity, value}`` rows.
+
+Regression gate (the ``profile_report.py --compare`` contract — two
+saved ``--json`` reports in, exit 1 when alerting got worse):
+
+    python tools/health_report.py --compare old.json new.json
+
+Exit status: 0 healthy (or no regression), 1 when alerts are firing at
+capture time, fired alerts never resolved, or compare found a
+regression.  Stdlib only — importable without the package (and
+without jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def read_events(path: str):
+    """Replay the structured event log: the rotated ``<path>.1``
+    generation first (when present), then the live file; non-JSON
+    (torn) lines are skipped — mirrors
+    ``horovod_tpu.metrics.EventLog.read`` so the tool stays
+    package-independent."""
+    for p in (path + ".1", path):
+        try:
+            f = open(p)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue                    # torn tail line
+
+
+def timeline_from_events(events) -> list[dict]:
+    """Normalized alert timeline from replayed event-log records
+    (``kind`` = ``alert.fire`` / ``alert.pending`` / ``alert.cancel``
+    / ``alert.resolve``)."""
+    rows = []
+    for e in events:
+        kind = e.get("kind", "")
+        if not kind.startswith("alert."):
+            continue
+        rows.append({"t": e.get("ts"), "rule": e.get("rule"),
+                     "event": kind[len("alert."):],
+                     "state": e.get("state"),
+                     "severity": e.get("severity"),
+                     "value": e.get("value")})
+    return rows
+
+
+def timeline_from_alerts(report: dict) -> list[dict]:
+    """Normalized alert timeline from a live ``/alerts`` payload
+    (``AlertManager.report()["history"]`` transitions)."""
+    return [{"t": tr.get("t"), "rule": tr.get("rule"),
+             "event": tr.get("event"), "state": tr.get("to"),
+             "severity": tr.get("severity"), "value": tr.get("value")}
+            for tr in report.get("history", [])]
+
+
+def timeline_key(timeline: list[dict]) -> list[tuple]:
+    """The timestamp-free equivalence key: live scrape and event-log
+    replay of one run must agree on this exactly (timestamps differ by
+    emit latency; the transition sequence must not)."""
+    return [(r["rule"], r["event"], r["state"]) for r in timeline]
+
+
+def scrape(url: str) -> dict:
+    """One live health capture: ``/alerts`` (required) + ``/advice``
+    (optional — 404 when no advisor is attached)."""
+    base = url.rstrip("/")
+    with urllib.request.urlopen(base + "/alerts", timeout=10) as r:
+        alerts = json.loads(r.read().decode())
+    advice = None
+    try:
+        with urllib.request.urlopen(base + "/advice", timeout=10) as r:
+            advice = json.loads(r.read().decode())
+    except (urllib.error.URLError, json.JSONDecodeError):
+        pass
+    return {"alerts": alerts, "advice": advice}
+
+
+def build_report(timeline: list[dict], *, source: str,
+                 alerts: dict | None = None,
+                 advice: dict | None = None) -> dict:
+    """The saved/printed report shape (both sources funnel here)."""
+    fired = sorted({r["rule"] for r in timeline
+                    if r["event"] == "fire"})
+    resolved = sorted({r["rule"] for r in timeline
+                       if r["event"] == "resolve"})
+    # End-state per rule from the timeline itself, so replay mode
+    # (no /alerts payload) still knows what is firing at capture time.
+    last_state: dict[str, str] = {}
+    for r in timeline:
+        last_state[r["rule"]] = r["state"]
+    firing = (alerts.get("firing") if alerts is not None
+              else sorted(n for n, s in last_state.items()
+                          if s == "firing"))
+    unresolved = sorted(set(fired) - set(resolved))
+    return {
+        "source": source,
+        "timeline": timeline,
+        "fired": fired,
+        "resolved": resolved,
+        "unresolved": unresolved,
+        "firing": firing,
+        "advice": advice,
+        "ok": not firing and not unresolved,
+    }
+
+
+def render(report: dict) -> str:
+    lines = [f"health report ({report['source']}): "
+             f"{len(report['timeline'])} alert transitions, "
+             f"{len(report['fired'])} rules fired, "
+             f"{len(report['resolved'])} resolved"]
+    if report["firing"]:
+        lines.append("FIRING NOW: " + ", ".join(report["firing"]))
+    if report["unresolved"]:
+        lines.append("fired but never resolved: "
+                     + ", ".join(report["unresolved"]))
+    if report["timeline"]:
+        lines.append(f"{'t':>14s} {'rule':24s} {'event':8s} "
+                     f"{'state':8s} {'sev':8s} value")
+        for r in report["timeline"]:
+            t = f"{r['t']:.3f}" if isinstance(r["t"], (int, float)) \
+                else str(r["t"])
+            v = (f"{r['value']:.4g}"
+                 if isinstance(r["value"], (int, float)) else "-")
+            lines.append(f"{t:>14s} {str(r['rule']):24s} "
+                         f"{str(r['event']):8s} {str(r['state']):8s} "
+                         f"{str(r['severity']):8s} {v}")
+    else:
+        lines.append("no alert transitions recorded")
+    adv = report.get("advice")
+    if adv:
+        last = adv.get("last") or adv
+        lines.append(f"capacity advice: {last.get('action', '?')} "
+                     f"n={last.get('n', 0)} — "
+                     f"{last.get('reason', '')}")
+    return "\n".join(lines)
+
+
+def compare(old: dict, new: dict) -> tuple[bool, list[str]]:
+    """The regression gate: alerting got worse when rules are firing
+    at capture time that weren't before, or fired rules stopped
+    resolving."""
+    problems: list[str] = []
+    for rule in new.get("firing", []):
+        if rule not in old.get("firing", []):
+            problems.append(f"{rule}: firing now, was not before")
+    for rule in new.get("unresolved", []):
+        if rule not in old.get("unresolved", []):
+            problems.append(f"{rule}: fired without resolving "
+                            f"(resolved before)")
+    return (not problems), problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url",
+                    help="live scrape: monitor/router base URL "
+                         "(GET /alerts + /advice)")
+    ap.add_argument("--events",
+                    help="replay: structured event-log JSONL path "
+                         "(reads <path>.1 generation too)")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    help="regression-gate two saved --json reports")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the report dict as JSON")
+    ap.add_argument("--out", help="also write the report JSON here")
+    args = ap.parse_args(argv)
+
+    n_sources = sum(bool(x) for x in
+                    (args.url, args.events, args.compare))
+    if n_sources != 1:
+        ap.error("give exactly one of: --url, --events, --compare")
+
+    if args.compare:
+        with open(args.compare[0]) as f:
+            old = json.load(f)
+        with open(args.compare[1]) as f:
+            new = json.load(f)
+        ok, problems = compare(old, new)
+        for p in problems:
+            print(f"REGRESSION: {p}")
+        if ok:
+            print("no alerting regressions")
+        return 0 if ok else 1
+
+    if args.url:
+        cap = scrape(args.url)
+        report = build_report(timeline_from_alerts(cap["alerts"]),
+                              source=args.url, alerts=cap["alerts"],
+                              advice=cap["advice"])
+    else:
+        report = build_report(
+            timeline_from_events(read_events(args.events)),
+            source=args.events)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,4 +9,5 @@ from tools.hvdlint.checkers import (  # noqa: F401
     hvd003_env_knobs,
     hvd004_fault_sites,
     hvd005_names,
+    hvd006_alert_rules,
 )

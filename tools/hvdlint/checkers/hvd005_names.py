@@ -110,7 +110,11 @@ class CounterNameChecker(Checker):
         help_names = set(project.metric_help)
         dynamic = (
             {"serve." + k for k in series.get("LIFECYCLE", ())}
-            | {"prefix." + k for k in series.get("PREFIX", ())})
+            | {"prefix." + k for k in series.get("PREFIX", ())}
+            # Per-endpoint scrape instruments: emitted as
+            # monitor.scrape_s.<endpoint> f-strings, documented under
+            # the family base name.
+            | {"monitor.scrape_s", "monitor.scrape_errors"})
         for name in sorted(set(metric_sites) - help_names):
             rel, line = metric_sites[name]
             yield Finding(

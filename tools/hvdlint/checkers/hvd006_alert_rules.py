@@ -1,0 +1,94 @@
+"""HVD006 — the canonical alert-rule table.
+
+``horovod_tpu.alerts.ALERT_RULES`` is what the pager keys on: the docs
+table is rendered from it, the AlertManager evaluates it, and the
+chaos-campaign oracle asserts coverage over it.  A rule that drifts
+from the metric registry or that no test exercises is a pager that
+never rings (or rings wrong), so every entry must:
+
+* be well-formed — the shared keys (``name``/``severity``/``kind``/
+  ``metric``/``pending_s``/``clear_s``/``help``) present, the ``kind``
+  one the evaluator implements, names unique;
+* watch a **registered** metric — ``rule["metric"]`` must have a
+  ``METRIC_HELP`` entry (an alert on an unregistered name evaluates
+  no-data forever);
+* be **asserted under tests/** — the rule name must appear literally in
+  a test file (the HVD004 fault-site pattern: unexercised alerting is
+  fiction).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_REQUIRED_KEYS = ("name", "severity", "kind", "metric", "pending_s",
+                  "clear_s", "help")
+#: The condition kinds AlertManager._condition implements.
+_KINDS = ("burn_rate", "drift", "slope", "threshold", "delta")
+
+
+@register
+class AlertRuleChecker(Checker):
+    code = "HVD006"
+    summary = ("ALERT_RULES entry malformed, watching an unregistered "
+               "metric, or asserted by no test")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        rules = project.alert_rules
+        alerts_rel = project.ALERTS_FILE
+        help_names = set(project.metric_help)
+        tests_text = "\n".join(
+            p.read_text() for p in project.test_files)
+
+        seen: set[str] = set()
+        for i, rule in enumerate(rules):
+            if not isinstance(rule, dict) or "name" not in rule:
+                yield Finding(
+                    self.code, alerts_rel,
+                    project.line_of(alerts_rel, "ALERT_RULES"),
+                    f"ALERT_RULES[{i}] is not a rule dict with a "
+                    "`name` key",
+                    symbol=f"rule[{i}]:malformed")
+                continue
+            name = rule["name"]
+            anchor = project.line_of(alerts_rel, f'"{name}"')
+            if name in seen:
+                yield Finding(
+                    self.code, alerts_rel, anchor,
+                    f"ALERT_RULES has duplicate rule name `{name}` — "
+                    "state machines and dedup key on the name",
+                    symbol=f"{name}:duplicate")
+                continue
+            seen.add(name)
+            missing = [k for k in _REQUIRED_KEYS if k not in rule]
+            if missing:
+                yield Finding(
+                    self.code, alerts_rel, anchor,
+                    f"ALERT_RULES entry `{name}` is missing required "
+                    f"keys {missing}",
+                    symbol=f"{name}:missing-keys")
+            if rule.get("kind") not in _KINDS:
+                yield Finding(
+                    self.code, alerts_rel, anchor,
+                    f"ALERT_RULES entry `{name}` has unknown kind "
+                    f"`{rule.get('kind')}` (evaluator implements "
+                    f"{list(_KINDS)})",
+                    symbol=f"{name}:unknown-kind")
+            metric = rule.get("metric")
+            if metric is not None and help_names \
+                    and metric not in help_names:
+                yield Finding(
+                    self.code, alerts_rel, anchor,
+                    f"ALERT_RULES entry `{name}` watches `{metric}` "
+                    "which has no metrics.METRIC_HELP entry — the "
+                    "rule would evaluate no-data forever",
+                    symbol=f"{name}:unregistered-metric")
+            if name not in tests_text:
+                yield Finding(
+                    self.code, alerts_rel, anchor,
+                    f"ALERT_RULES entry `{name}` is referenced by no "
+                    "test under tests/ — unexercised alerting is "
+                    "fiction",
+                    symbol=f"{name}:no-test-reference")

@@ -201,6 +201,7 @@ class Project:
 
     METRICS_FILE = "horovod_tpu/metrics.py"
     KNOBS_FILE = "horovod_tpu/knobs.py"
+    ALERTS_FILE = "horovod_tpu/alerts.py"
 
     def __init__(self, root: str | pathlib.Path, *,
                  package_dirs: tuple[str, ...] = ("horovod_tpu",),
@@ -211,6 +212,7 @@ class Project:
                  metric_help: dict | None = None,
                  timeline_counter_series: dict | None = None,
                  lifecycle_event_counters: dict | None = None,
+                 alert_rules: tuple | None = None,
                  hvd001_targets: tuple[str, ...] | None = None,
                  hvd002_strict_files: tuple[str, ...] | None = None):
         self.root = pathlib.Path(root).resolve()
@@ -235,6 +237,7 @@ class Project:
         self._metric_help = metric_help
         self._timeline_counter_series = timeline_counter_series
         self._lifecycle_event_counters = lifecycle_event_counters
+        self._alert_rules = alert_rules
         self.hvd001_targets = hvd001_targets
         self.hvd002_strict_files = hvd002_strict_files
 
@@ -272,6 +275,13 @@ class Project:
     def lifecycle_event_counters(self) -> dict:
         return self._table(self._lifecycle_event_counters, self.METRICS_FILE,
                            "LIFECYCLE_EVENT_COUNTERS", {})
+
+    @property
+    def alert_rules(self) -> tuple:
+        """``horovod_tpu.alerts.ALERT_RULES``: the canonical alert-rule
+        dicts (pure literal, like every other table)."""
+        return self._table(self._alert_rules, self.ALERTS_FILE,
+                           "ALERT_RULES", ())
 
     # -- anchors -----------------------------------------------------------
 

@@ -11,7 +11,9 @@ Multi-rank merge (per-rank traces from a ``{rank}``-templated
 each event's ``pid`` becomes its rank (the original tensor pid moves to
 ``tid``), so chrome://tracing shows one process lane per rank; summary
 and ``--json`` modes aggregate across the ranks, with tensors prefixed
-``r<k>/``:
+``r<k>/``.  Per-rank traces use per-process monotonic origins, so the
+merge time-aligns them on their first common event (``rank_shifts``)
+before stitching:
 
     python tools/timeline_summary.py --merge r0.json r1.json --out all.json
 
@@ -51,19 +53,60 @@ def load_events(path: str) -> list[dict]:
     return data["traceEvents"] if isinstance(data, dict) else data
 
 
+def rank_shifts(traces: list[list[dict]]) -> list[float]:
+    """Per-rank timestamp shifts (us, add to ``ts``) aligning traces on
+    their first common event.
+
+    Each rank's trace uses its own monotonic origin (the writer stamps
+    a per-process clock), so raw merges skew lanes by process start
+    time.  Wall clocks can't fix that — they step and drift — but
+    monotonic *deltas* are trustworthy, so the merge anchors on the
+    earliest event *name* every rank recorded (the one whose latest
+    first-occurrence across ranks is smallest) and shifts each rank so
+    its first occurrence of that anchor lands at the same instant (the
+    minimum across ranks).  No common event → zero shifts (nothing to
+    anchor on beats a wrong anchor)."""
+    firsts: list[dict[str, float]] = []
+    for events in traces:
+        first: dict[str, float] = {}
+        for e in events:
+            if e.get("ph") == "M" or "ts" not in e:
+                continue
+            name = e.get("name", "")
+            if name not in first or e["ts"] < first[name]:
+                first[name] = e["ts"]
+        firsts.append(first)
+    common = set.intersection(*(set(f) for f in firsts)) if firsts else set()
+    if not common or len(firsts) < 2:
+        return [0.0] * len(traces)
+    anchor = min(common, key=lambda n: max(f[n] for f in firsts))
+    target = min(f[anchor] for f in firsts)
+    return [target - f[anchor] for f in firsts]
+
+
+def _shifted(e: dict, shift: float) -> dict:
+    e = dict(e)
+    if shift and "ts" in e:
+        e["ts"] = e["ts"] + shift
+    return e
+
+
 def merge_chrome(paths: list[str]) -> list[dict]:
     """Stitch per-rank Chrome traces into ONE: rank k's events get
     ``pid=k`` (one process lane per rank in chrome://tracing) and keep
     their original tensor pid as ``tid``; the per-tensor
     ``process_name`` metadata becomes per-rank ``thread_name`` rows and
-    each rank lane is labeled ``rank k``."""
+    each rank lane is labeled ``rank k``.  Lanes are time-aligned on
+    the first common event (:func:`rank_shifts`)."""
+    traces = [load_events(p) for p in paths]
+    shifts = rank_shifts(traces)
     out: list[dict] = []
-    for rank, path in enumerate(paths):
+    for rank, events in enumerate(traces):
         out.append({"name": "process_name", "ph": "M", "pid": rank,
                     "args": {"name": f"rank {rank}"}})
         out.append({"name": "process_sort_index", "ph": "M", "pid": rank,
                     "args": {"sort_index": rank}})
-        for e in load_events(path):
+        for e in events:
             orig_pid = e.get("pid", 0)
             if e.get("ph") == "M":
                 if e.get("name") == "process_name":
@@ -73,7 +116,7 @@ def merge_chrome(paths: list[str]) -> list[dict]:
                 # drop other process-level metadata (sort indices etc.:
                 # they would re-order the rank lanes)
                 continue
-            e = dict(e)
+            e = _shifted(e, shifts[rank])
             e["pid"] = rank
             # The tensor identity lives in the original pid (the writer
             # emits a constant tid 0), so tid must be overwritten, not
@@ -88,11 +131,15 @@ def merge_for_summary(paths: list[str]) -> list[dict]:
     unique per (rank, tensor) — ``summarize`` pairs B/E by (pid, name),
     so colliding tensor pids across ranks would cross-pair.  Tensor
     names gain an ``r<k>/`` prefix; counter/instant/span names stay
-    shared so those series aggregate fleet-wide."""
+    shared so those series aggregate fleet-wide.  Timestamps get the
+    same first-common-event alignment as :func:`merge_chrome` so
+    cross-rank span/counter aggregation compares like instants."""
+    traces = [load_events(p) for p in paths]
+    shifts = rank_shifts(traces)
     out: list[dict] = []
-    for rank, path in enumerate(paths):
-        for e in load_events(path):
-            e = dict(e)
+    for rank, events in enumerate(traces):
+        for e in events:
+            e = _shifted(e, shifts[rank])
             e["pid"] = rank * 1_000_000 + e.get("pid", 0)
             if (e.get("ph") == "M" and e.get("name") == "process_name"
                     and e.get("args")):
