@@ -908,6 +908,60 @@ def _bench_serve_load(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_autoscale(hvd, on_tpu: bool) -> dict:
+    """Elastic-capacity arm (extras, TPU only): one seeded Bursty
+    open-loop schedule against a single-replica fleet, then the same
+    schedule after a scripted :class:`FleetAutoscaler` scale-up
+    through the supervisor's factory seam
+    (``horovod_tpu.autoscaler.measure_autoscale_goodput``).
+    ``serve_autoscale_goodput_retention`` (post-grow goodput over
+    pre-grow goodput on the identical burst) is the headline: how much
+    SLO-good work the grow won back.  The arm finishes with a scripted
+    scale-down, so the zero-drop cordon → drain → retire round trip
+    runs under the bench; ``serve_autoscale_scale_ok`` (grew, served,
+    retired back to baseline, epoch advanced twice, no leaked
+    tickets) is the acceptance bar."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.autoscaler import measure_autoscale_goodput
+    from horovod_tpu.models import llama
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, one short burst.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        kw = dict(rate=48.0, duration_s=0.5, n_slots=4, chunk=8)
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        kw = dict(rate=16.0, duration_s=2.0, n_slots=8, chunk=32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    r = measure_autoscale_goodput(params, cfg, seed=0, **kw)
+    return {
+        "serve_autoscale_goodput_pre": round(
+            r["serve_autoscale_goodput_pre"], 3),
+        "serve_autoscale_goodput_post": round(
+            r["serve_autoscale_goodput_post"], 3),
+        "serve_autoscale_goodput_retention": round(
+            r["serve_autoscale_goodput_retention"], 3),
+        "serve_autoscale_p99_ttft_pre_ms": round(
+            r["serve_autoscale_p99_ttft_pre_ms"], 2),
+        "serve_autoscale_p99_ttft_post_ms": round(
+            r["serve_autoscale_p99_ttft_post_ms"], 2),
+        "serve_autoscale_requests": r["serve_autoscale_requests"],
+        "serve_autoscale_epoch": r["serve_autoscale_epoch"],
+        "serve_autoscale_scale_ok": r["serve_autoscale_scale_ok"],
+        "serve_autoscale_shape": (
+            f"r1_grow1_rate{kw['rate']:g}_d{kw['duration_s']}_"
+            f"bursty_seed0"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1414,6 +1468,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
                _bench_serving_overcommit, _bench_serve_prefix,
                _bench_serve_spec, _bench_serve_router,
                _bench_serve_chaos, _bench_serve_load,
+               _bench_serve_autoscale,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):

@@ -110,6 +110,12 @@ ALERT_RULES = (
      "pending_s": 0.0, "clear_s": 300.0,
      "help": "Three or more supervisor respawns inside 5 m -- the "
              "fleet is flapping, not healing."},
+    {"name": "autoscaler_flap", "severity": "ticket",
+     "kind": "delta", "metric": "autoscaler.actions",
+     "min_delta": 3.0, "window_s": 600.0,
+     "pending_s": 0.0, "clear_s": 300.0,
+     "help": "Three or more autoscaler actuations inside 10 m -- the "
+             "fleet is resizing faster than demand can justify."},
 )
 
 
@@ -431,6 +437,7 @@ class CapacityAdvisor:
             "firing": firing,
             "window_s": w,
             "objective": self.objective,
+            "headroom": self.headroom,
         }
         action, n, reason = self._decide(goodput, queue, kv_slope,
                                          done, knee, n_replicas,
@@ -473,10 +480,16 @@ class CapacityAdvisor:
                 and n_replicas > 1 and knee and knee > 0
                 and done["rate"] < knee * self.low_util
                 * (n_replicas - 1)):
-            return ("scale_down", 1,
+            # Demand-sized like scale_up: replicas the observed rate
+            # actually needs at knee-with-headroom, never shrinking
+            # past one survivor.
+            need = max(math.ceil(done["rate"]
+                                 / (knee * self.headroom)), 1)
+            n = max(min(n_replicas - need, n_replicas - 1), 1)
+            return ("scale_down", n,
                     f"goodput ok and {done['rate']:.2f} rps fits "
-                    f"{n_replicas - 1} replicas below "
-                    f"{self.low_util:g} of knee")
+                    f"{need} replica(s) at {self.headroom:g} of "
+                    f"{knee:g} rps knee")
         return "hold", 0, "within envelope"
 
     def report(self) -> dict:

@@ -35,6 +35,18 @@ router+supervisor fleet serving a canned workload, then checks the
   ``replica_death``.  Alerting that misses a storm it watched is a
   broken pager.
 
+:func:`run_autoscale_campaign` is the elastic-fleet variant: a
+deterministic traffic step with scripted
+:class:`~horovod_tpu.autoscaler.FleetAutoscaler` actuations
+interleaved — a faulted grow that must degrade to ``hold``, a real
+grow whose replica must serve routed traffic, and a scale-down that
+lands while a keyed wave is in flight, so the cordoned victim fails
+open into journal/failover replay.  Its oracles add ``zero_dropped``
+(every routed request terminates ``OK``), ``exactly_once``
+(resubmitting every idempotency key after the epoch bump answers from
+the journal without touching a replica), ``grew_and_served``, and
+``drained_and_retired`` to the storm invariants above.
+
 :func:`soak` repeats campaigns with consecutive seeds until a
 wall-clock budget runs out (the long-haul mode); :func:`compare_campaigns`
 is the JSON regression gate (the ``profile_report.py --compare``
@@ -480,4 +492,268 @@ def measure_chaos_goodput(params: dict, cfg: Any, *, seed: int = 0,
         "serve_chaos_ok_fraction": report["ok_fraction"],
         "serve_chaos_goodput_retention": report["ok_fraction"],
         "serve_chaos_oracles_ok": report["ok"],
+    }
+
+
+def run_autoscale_campaign(params: dict, cfg: Any, *,
+                           n_replicas: int = 2, n_groups: int = 3,
+                           waves: int = 6, n_slots: int = 2,
+                           max_len: int = 64, chunk: int = 8,
+                           backoff_s: float = 0.01,
+                           event_log: str | None = None,
+                           journal: str | None = None,
+                           timeout_s: float = 300.0,
+                           drain_s: float = 0.0,
+                           fault_first_grow: bool = True) -> dict:
+    """One deterministic elastic-fleet campaign: a traffic step with
+    scripted autoscaler actuations interleaved into live serving.
+
+    The script (no randomness — every phase is a fixed function of the
+    arguments, so a failure is exactly reproducible):
+
+    1. **Calm**: the first third of the waves on the starting fleet.
+    2. **Faulted grow** (``fault_first_grow``): a ``serve.autoscale``
+       rule armed on the first actuation attempt must degrade the
+       scale-up to ``hold`` — membership untouched, nothing dropped.
+    3. **Grow**: the retry joins a fresh replica through the
+       supervisor's factory seam (epoch bump #1).
+    4. **Burst**: the middle third of the waves routed as one block —
+       the traffic step the grow answered; the new replica must have
+       served routed traffic by the end of it.
+    5. **Shrink under load**: one wave is routed with idempotency keys
+       and the scale-down is actuated while it is in flight.  With the
+       default ``drain_s=0`` the cordoned victim fails open through
+       the crash path: in-flight callbacks fire ``None`` and the
+       router replays each request on a survivor, bit-identically.
+       The drain converges to a retire (epoch bump #2).
+    6. **Exactly-once probe**: every key from phase 5 is resubmitted
+       after the epoch bump; the journal must answer all of them
+       without a single new engine submission.
+    7. **Tail**: the remaining waves on the shrunk fleet.
+
+    The autoscaler runs with its organic advisor loop idle (no sampler
+    in the fleet, so ``router.advisor`` is ``None``) and zeroed
+    cooldown/stabilization guards — the campaign owns the decision
+    sequence; the guards and the advisor loop have their own
+    virtual-clock tests.  Returns an oracle report shaped like
+    :func:`run_campaign`'s; ``report["ok"]`` is the AND of every
+    oracle."""
+    from horovod_tpu.autoscaler import FleetAutoscaler
+    from horovod_tpu.serving_scheduler import ServeEngine
+
+    if waves < 5:
+        raise ValueError("the autoscale campaign needs waves >= 5 "
+                         "(calm / burst / shrink / tail phases)")
+    workload = _workload(n_groups, waves)
+    calm = max(waves // 3, 1)
+    burst = max(waves // 3, 1)
+
+    # Fault-free reference: as in run_campaign, one solo engine's
+    # greedy output IS the elastic fleet's expected output — joins,
+    # cordons, forced drains, and journal dedup must not change bits.
+    ref_engine = ServeEngine(params, cfg, n_slots=n_slots,
+                             max_len=max_len, chunk=chunk,
+                             prefix_cache=True, monitor=False,
+                             metrics=metrics_mod.NULL)
+    reference = ref_engine.run(workload)
+
+    fr = faults_mod.FaultRegistry()
+    if fault_first_grow:
+        fr.inject("serve.autoscale", on_hit=1, count=1)
+    reg = metrics_mod.MetricsRegistry()
+    engines = [ServeEngine(params, cfg, n_slots=n_slots,
+                           max_len=max_len, chunk=chunk,
+                           prefix_cache=True, monitor=False,
+                           faults=fr, metrics=reg, sampler=False)
+               for _ in range(n_replicas)]
+    tmpdir = (tempfile.mkdtemp(prefix="hvd-autoscale-")
+              if event_log is None or journal is None else None)
+    if event_log is None:
+        event_log = os.path.join(tmpdir, "autoscale-events.jsonl")
+    if journal is None:
+        journal = os.path.join(tmpdir, "autoscale-journal.jsonl")
+    prior_log = os.environ.get("HVD_TPU_EVENT_LOG")
+    os.environ["HVD_TPU_EVENT_LOG"] = event_log
+
+    router = RouterServer(engines, policy="round_robin", registry=reg,
+                          faults=fr, journal=journal)
+    sup = ReplicaSupervisor(router, backoff_s=backoff_s,
+                            warm_prefixes=4)
+    asc = FleetAutoscaler(router, supervisor=sup, enabled=True,
+                          cooldown_s=0.0, stable_s=0.0,
+                          min_replicas=1, max_replicas=n_replicas + 2,
+                          step=1, drain_s=drain_s, faults=fr)
+
+    samples: list[dict] = []
+    results: list[Any] = []
+    decisions: dict[str, dict] = {}
+    deadline = time.monotonic() + timeout_s
+
+    def _collect(rids: list[int]) -> list[Any]:
+        out = []
+        for rid in rids:
+            while True:
+                res = router.result(rid, timeout=0.05)
+                if res is not None:
+                    out.append(res)
+                    break
+                router.poll_now()
+                if time.monotonic() > deadline:
+                    raise RuntimeError("autoscale campaign stalled")
+        return out
+
+    def _wave(w: int) -> list[Request]:
+        return workload[w * n_groups:(w + 1) * n_groups]
+
+    try:
+        for w in range(calm):
+            results.extend(_collect([router.route(r)
+                                     for r in _wave(w)]))
+        samples.append(dict(reg.snapshot()["counters"]))
+
+        if fault_first_grow:
+            decisions["faulted_grow"] = asc.actuate(
+                {"action": "scale_up", "n": 1,
+                 "reason": "campaign traffic step"})
+        with router._lock:
+            size_after_fault = len(router.replicas)
+        decisions["grow"] = asc.actuate(
+            {"action": "scale_up", "n": 1,
+             "reason": "campaign traffic step"})
+        grown = list(decisions["grow"].get("replicas", []))
+        with router._lock:
+            grown_size = len(router.replicas)
+
+        lo, hi = calm * n_groups, (calm + burst) * n_groups
+        results.extend(_collect([router.route(r)
+                                 for r in workload[lo:hi]]))
+        with router._lock:
+            routed_new = sum(router._routed.get(n, 0) for n in grown)
+        samples.append(dict(reg.snapshot()["counters"]))
+
+        # Shrink while the keyed wave is in flight: the cordon lands
+        # between route and result, so the victim drains (or fails
+        # open) under real load.
+        drain_reqs = _wave(calm + burst)
+        keys = [f"autoscale-{i}" for i in range(len(drain_reqs))]
+        rids = [router.route(r, idempotency_key=k)
+                for r, k in zip(drain_reqs, keys)]
+        decisions["shrink"] = asc.actuate(
+            {"action": "scale_down", "n": 1,
+             "reason": "campaign step down"})
+        drained = _collect(rids)
+        results.extend(drained)
+        while asc.draining() and time.monotonic() < deadline:
+            router.poll_now()
+            time.sleep(backoff_s)
+
+        submitted_before = reg.snapshot()["counters"].get(
+            "serve.requests_submitted", 0)
+        dedups_before = reg.snapshot()["counters"].get(
+            "router.journal_dedups", 0)
+        dups = _collect([router.route(r, idempotency_key=k)
+                         for r, k in zip(drain_reqs, keys)])
+        counters_now = reg.snapshot()["counters"]
+        new_submits = (counters_now.get("serve.requests_submitted", 0)
+                       - submitted_before)
+        new_dedups = (counters_now.get("router.journal_dedups", 0)
+                      - dedups_before)
+
+        for w in range(calm + burst + 1, waves):
+            results.extend(_collect([router.route(r)
+                                     for r in _wave(w)]))
+        samples.append(dict(reg.snapshot()["counters"]))
+
+        router.reap_tickets(0)
+        leaked_tickets = router.memory_report()["tickets"]
+        leaked_blocks = 0
+        block_errors: list[str] = []
+        with router._lock:
+            survivors = list(router.replicas)
+        for r in survivors:
+            eng = getattr(r, "engine", None)
+            if eng is None:
+                continue
+            total = int(eng.pcache.k.shape[1]) - 1
+            free = eng.free_block_count() + eng.cached_block_count()
+            leaked_blocks += total - free
+            if eng.prefix is not None:
+                try:
+                    eng.prefix.check_consistency()
+                except AssertionError as e:
+                    block_errors.append(f"{r.name}: {e}")
+        final_size = len(survivors)
+        final_cordoned = router.cordoned()
+        epoch = asc.epoch.snapshot()
+    finally:
+        os.environ.pop("HVD_TPU_EVENT_LOG", None)
+        if prior_log is not None:
+            os.environ["HVD_TPU_EVENT_LOG"] = prior_log
+        router.stop()
+
+    fired = list(fr.log)
+    events = metrics_mod.EventLog.read(event_log)
+    logged = [(e.get("site"), e.get("key"), e.get("hit"))
+              for e in events if e.get("kind") == "fault"]
+    missing = [f for f in fired if (f[0], f[1], f[2]) not in logged]
+    drain_forced = any(e.get("kind") == "autoscaler.drain_force"
+                       for e in events)
+    regressed = _counters_regressed(samples)
+    n_ok = sum(1 for r in results if r.status == OK)
+    mismatches = [i for i, (res, ref) in enumerate(zip(results,
+                                                       reference))
+                  if list(res) != list(ref) or res.status != OK]
+    dup_mismatches = [i for i, (dup, orig) in enumerate(zip(dups,
+                                                            drained))
+                      if dup.status != OK or list(dup) != list(orig)]
+    faulted = decisions.get("faulted_grow")
+
+    oracles = {
+        "bit_identical": not mismatches,
+        "zero_dropped": n_ok == len(workload),
+        "exactly_once": (not dup_mismatches
+                         and new_submits == 0
+                         and new_dedups == len(keys)),
+        "grew_and_served": (decisions["grow"]["action"] == "scale_up"
+                            and grown_size == n_replicas + 1
+                            and routed_new > 0),
+        "drained_and_retired": (
+            decisions["shrink"]["action"] == "scale_down"
+            and final_size == n_replicas
+            and not final_cordoned
+            and epoch["generation"] >= 2),
+        "fault_degraded_to_hold": (
+            not fault_first_grow
+            or (faulted is not None
+                and faulted["action"] == "hold"
+                and size_after_fault == n_replicas)),
+        "no_leaked_tickets": leaked_tickets == 0,
+        "no_leaked_blocks": leaked_blocks == 0 and not block_errors,
+        "metrics_monotonic": not regressed,
+        "faults_logged": not missing,
+    }
+    counters = samples[-1] if samples else {}
+    return {
+        "n_requests": len(workload),
+        "n_ok": n_ok,
+        "ok_fraction": n_ok / len(workload),
+        "grown_replicas": grown,
+        "routed_to_grown": routed_new,
+        "drain_forced": drain_forced,
+        "dedups": new_dedups,
+        "epoch": epoch,
+        "decisions": decisions,
+        "scale_ups": counters.get("autoscaler.scale_ups", 0),
+        "scale_downs": counters.get("autoscaler.scale_downs", 0),
+        "hold_faults": counters.get("autoscaler.hold_faults", 0),
+        "failovers": counters.get("router.failovers", 0),
+        "leaked_tickets": leaked_tickets,
+        "leaked_blocks": leaked_blocks,
+        "block_errors": block_errors,
+        "counter_regressions": regressed,
+        "unlogged_faults": [list(f) for f in missing],
+        "mismatched_requests": mismatches,
+        "event_log": event_log,
+        "oracles": oracles,
+        "ok": all(oracles.values()),
     }

@@ -37,6 +37,12 @@ Named sites currently wired:
                    (key = replica name) — a firing rule fails that
                    attempt, burning one unit of the replica's restart
                    budget and advancing its backoff
+``serve.autoscale``  per actuation attempt in the
+                   :class:`~horovod_tpu.autoscaler.FleetAutoscaler`
+                   (key = action name) — a firing rule degrades that
+                   actuation to ``hold``; routing and in-flight
+                   requests are untouched, so a faulted autoscaler
+                   never drops a request
 ``router.journal``  per append to the router's request-journal WAL
                    (key = record kind) — a firing rule loses that
                    record (the request is still served; durability

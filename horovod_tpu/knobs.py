@@ -68,6 +68,18 @@ ENV_KNOBS = (
      "Enable 64-bit jax types for the torch-compat surface."),
     ("HVD_TPU_ALERTS", "1",
      "Evaluate ALERT_RULES over the sampled series (0 = off)."),
+    ("HVD_TPU_AUTOSCALE", "0",
+     "Actuate CapacityAdvisor recommendations from the router poller."),
+    ("HVD_TPU_AUTOSCALE_COOLDOWN_S", "30",
+     "Seconds the autoscaler rests between actuations."),
+    ("HVD_TPU_AUTOSCALE_MAX_REPLICAS", "8",
+     "Fleet size ceiling the autoscaler will not grow past."),
+    ("HVD_TPU_AUTOSCALE_MIN_REPLICAS", "1",
+     "Fleet size floor the autoscaler will not shrink below."),
+    ("HVD_TPU_AUTOSCALE_STABLE_S", "60",
+     "Seconds of sustained shrink advice before a scale-down starts."),
+    ("HVD_TPU_AUTOSCALE_STEP", "1",
+     "Replicas added or retired per autoscaler action at most."),
     ("HVD_TPU_BENCH_CACHE", "",
      "Directory for cached benchmark baselines (default: repo-local)."),
     ("HVD_TPU_DRAFT_K", "4",

@@ -90,6 +90,7 @@ FAULT_SITES: tuple[str, ...] = (
     "serve.draft",
     "serve.router",
     "serve.supervisor",
+    "serve.autoscale",
     "router.journal",
     "data.producer",
 )
@@ -232,6 +233,16 @@ METRIC_HELP: dict[str, str] = {
     "supervisor.respawn_failures": "Respawn attempts that failed (fault or factory error)",
     "supervisor.permanent_deaths": "Replicas circuit-broken to permanent-dead after exhausting restarts",
     "supervisor.warm_prefixes": "Hot prompts replayed into a fresh engine to rewarm its prefix cache",
+    # autoscaler.* — the advisor-driven elastic actuator (horovod_tpu.autoscaler)
+    "autoscaler.epoch": "Fleet membership generation (bumped on every join/leave)",
+    "autoscaler.actions": "Actuations initiated (scale-up joins plus scale-down cordons)",
+    "autoscaler.scale_ups": "Replicas added to the fleet by the autoscaler",
+    "autoscaler.scale_downs": "Replicas retired from the fleet after a zero-drop drain",
+    "autoscaler.holds": "Recommendations not actuated (hold advice, guards, or a degraded action)",
+    "autoscaler.hold_faults": "Actuations degraded to hold by a serve.autoscale fault",
+    "autoscaler.cordons": "Replicas cordoned out of routing pending drain",
+    "autoscaler.draining": "Replicas currently cordoned and draining in-flight work",
+    "autoscaler.replicas_target": "Fleet size the last actuation drove toward",
 }
 
 

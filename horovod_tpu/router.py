@@ -833,11 +833,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self._reply(200,
                                 json.dumps(router.advisor.report()),
                                 "application/json")
+            elif path == "/autoscaler":
+                if router.autoscaler is None:
+                    self._reply(404, "no autoscaler attached "
+                                     "(HVD_TPU_AUTOSCALE)\n",
+                                "text/plain")
+                else:
+                    self._reply(200,
+                                json.dumps(router.autoscaler.report()),
+                                "application/json")
             else:
                 self._reply(404, "unknown path; try /v1/generate "
                                  "/replicas /snapshot /healthz "
                                  "/metrics /state /timeseries "
-                                 "/alerts /advice\n",
+                                 "/alerts /advice /autoscaler\n",
                             "text/plain")
         except BrokenPipeError:
             pass
@@ -890,9 +899,9 @@ class RouterServer:
     lock held, so the reverse edge never forms."""
 
     _GUARDED_BY_LOCK = ("_tickets", "_views", "_shadows", "_inflight",
-                        "_routed", "_dead", "_probe_fails", "_next_rid",
-                        "_journal_results", "_journal_inflight",
-                        "_journal_waiters")
+                        "_routed", "_dead", "_cordoned", "_probe_fails",
+                        "_next_rid", "_journal_results",
+                        "_journal_inflight", "_journal_waiters")
 
     class _Server(ThreadingHTTPServer):
         daemon_threads = True
@@ -963,6 +972,7 @@ class RouterServer:
         self._lock = threading.Lock()
         self._next_rid = 0
         self._tickets: dict[int, _Ticket] = {}
+        self.shadow_max_paths = shadow_max_paths
         self._probe_fails: dict[str, int] = {r.name: 0
                                              for r in self.replicas}
         self._views: dict[str, dict] = {}
@@ -973,6 +983,10 @@ class RouterServer:
                                           for r in self.replicas}
         self._routed: dict[str, int] = {r.name: 0 for r in self.replicas}
         self._dead: set[str] = set()
+        # Cordoned replicas stay healthy and keep draining their
+        # in-flight work but receive no new placements — the
+        # autoscaler's scale-down staging area.
+        self._cordoned: set[str] = set()
 
         # Crash-durable request journal (off unless a path is set).
         # Recovery happens HERE, before any routing: incomplete accepts
@@ -1071,6 +1085,14 @@ class RouterServer:
         self.advisor = (alerts_mod.CapacityAdvisor(
             self.sampler, alerts=self.alerts, registry=self.metrics)
             if self.sampler is not None else None)
+        #: A :class:`~horovod_tpu.autoscaler.FleetAutoscaler`, once
+        #: attached — ticked by the poller after the health plane so
+        #: it actuates against this pass's fresh views.  Env-gated
+        #: here (HVD_TPU_AUTOSCALE); tests and campaigns attach one
+        #: explicitly.
+        from horovod_tpu import autoscaler as autoscaler_mod
+        self.autoscaler: Any = None
+        autoscaler_mod.maybe_autoscaler(self)
 
         self._httpd = RouterServer._Server((host, port), _RouterHandler)
         self._httpd.router = self
@@ -1358,7 +1380,14 @@ class RouterServer:
         never-polled replica counts as healthy and empty (no evidence
         of badness — exactly the SLO window's empty-window stance)."""
         healthy = [r.name for r in self.replicas
-                   if r.name not in self._dead]
+                   if r.name not in self._dead
+                   and r.name not in self._cordoned]
+        if not healthy:
+            # A fully-cordoned-but-alive fleet still serves (the
+            # cordon is advisory scale-down staging, not an outage);
+            # only a fleet with no live replica at all sheds.
+            healthy = [r.name for r in self.replicas
+                       if r.name not in self._dead]
         if not healthy:
             return "no_replicas"
         if self.min_goodput > 0:
@@ -1387,7 +1416,15 @@ class RouterServer:
         onto it (caller submits outside the lock); returns the handle
         plus the policy's info dict for the ``router.route`` event."""
         candidates = [r.name for r in self.replicas
-                      if r.name not in self._dead]
+                      if r.name not in self._dead
+                      and r.name not in self._cordoned]
+        if not candidates:
+            # Never fail a request over a cordon: if every live
+            # replica is cordoned (mid-drain fleet at the min bound,
+            # or a failover racing a scale-down), place on a live
+            # cordoned replica rather than dropping.
+            candidates = [r.name for r in self.replicas
+                          if r.name not in self._dead]
         ctx = RoutingContext(self._views, self._shadows, self._inflight,
                              self.imbalance)
         name, info = self.policy.choose(candidates, ticket.req, ctx)
@@ -1530,6 +1567,94 @@ class RouterServer:
             self._views.pop(name, None)
         self._mark_alive(name)
 
+    # -- elastic membership (the autoscaler's actuation surface) -----------
+
+    def cordon_replica(self, name: str) -> None:
+        """Remove a replica from the routing candidate set without
+        touching its health: no new placements land on it, while its
+        in-flight requests keep draining (finish normally, or fail
+        open into failover/journal replay if it dies).  Probes, views,
+        and the shadow index all keep running, so :meth:`uncordon_replica`
+        is a full no-cost undo."""
+        with self._lock:
+            if not any(r.name == name for r in self.replicas):
+                raise KeyError(name)
+            if name in self._cordoned:
+                return
+            self._cordoned.add(name)
+        self.metrics.event("router.cordon", replica=name)
+
+    def uncordon_replica(self, name: str) -> None:
+        """Return a cordoned replica to the candidate set."""
+        with self._lock:
+            if name not in self._cordoned:
+                return
+            self._cordoned.discard(name)
+        self.metrics.event("router.uncordon", replica=name)
+
+    def add_replica(self, handle: Any, *,
+                    name: str | None = None) -> ReplicaHandle:
+        """Join a brand-new replica to the fleet (the autoscaler's
+        grow commit point; bare engines wrap like the constructor).
+        The newcomer starts with an empty shadow index and zero
+        counters and is immediately routable."""
+        if not isinstance(handle, ReplicaHandle):
+            handle = LocalReplica(handle,
+                                  name=name or "replica-new",
+                                  faults=self.faults)
+        if isinstance(handle, LocalReplica) and handle.on_death is None:
+            handle.on_death = self._on_replica_death
+        with self._lock:
+            if any(r.name == handle.name for r in self.replicas):
+                raise ValueError(
+                    f"duplicate replica name {handle.name!r}")
+            self.replicas.append(handle)
+            self._probe_fails[handle.name] = 0
+            self._shadows[handle.name] = ShadowPrefixIndex(
+                handle.block_size, self.shadow_max_paths)
+            self._inflight[handle.name] = 0
+            self._routed[handle.name] = 0
+            healthy = len(self.replicas) - len(self._dead)
+        self.metrics.gauge("router.replicas_healthy").set(healthy)
+        self.metrics.event("router.replica_join", replica=handle.name)
+        return handle
+
+    def retire_replica(self, name: str, *,
+                       stop: bool = True) -> ReplicaHandle:
+        """Remove a replica from the fleet entirely (the autoscaler's
+        scale-down commit point, after cordon + drain).  The caller
+        owns the drain: retiring with in-flight work abandons those
+        callbacks, so cordon first and wait for (or force) zero
+        inflight.  Returns the removed handle."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                raise ValueError(
+                    "refusing to retire the last replica")
+            for i, r in enumerate(self.replicas):
+                if r.name == name:
+                    handle = self.replicas.pop(i)
+                    break
+            else:
+                raise KeyError(name)
+            inflight = self._inflight.pop(name, 0)
+            self._routed.pop(name, None)
+            self._views.pop(name, None)
+            self._shadows.pop(name, None)
+            self._probe_fails.pop(name, None)
+            self._cordoned.discard(name)
+            self._dead.discard(name)
+            healthy = len(self.replicas) - len(self._dead)
+        self.metrics.gauge("router.replicas_healthy").set(healthy)
+        self.metrics.event("router.replica_retire", replica=name,
+                           inflight=inflight)
+        if stop:
+            handle.stop()
+        return handle
+
+    def cordoned(self) -> list[str]:
+        with self._lock:
+            return sorted(self._cordoned)
+
     # -- the request journal -----------------------------------------------
 
     def _journal_append(self, kind: str, **fields: Any) -> None:
@@ -1662,6 +1787,9 @@ class RouterServer:
             self.sampler.tick()
             if self.alerts is not None:
                 self.alerts.tick()
+        asc = self.autoscaler
+        if asc is not None:
+            asc.tick()
         self.reap_tickets()
 
     def _poll_loop(self) -> None:
@@ -1682,10 +1810,17 @@ class RouterServer:
         with self._lock:
             healthy = [r.name for r in self.replicas
                        if r.name not in self._dead]
+            cordoned = sorted(self._cordoned)
+            draining = sorted(n for n in self._cordoned
+                              if self._inflight.get(n, 0) > 0)
             body = {"ok": bool(healthy), "replicas": len(self.replicas),
-                    "healthy": len(healthy), "pid": os.getpid()}
+                    "healthy": len(healthy), "pid": os.getpid(),
+                    "cordoned": cordoned, "draining": draining}
         sup = self.supervisor
         body["degraded"] = bool(sup is not None and sup.degraded())
+        asc = self.autoscaler
+        if asc is not None:
+            body["epoch"] = asc.epoch.generation
         return (200 if body["ok"] else 503), body
 
     def state_dump(self) -> str:
@@ -1700,6 +1835,7 @@ class RouterServer:
             n_done = sum(1 for t in self._tickets.values()
                          if t.done.is_set())
             dead = set(self._dead)
+            cordoned = set(self._cordoned)
             rows = [(r.name, self._routed.get(r.name, 0),
                      self._inflight.get(r.name, 0))
                     for r in self.replicas]
@@ -1711,8 +1847,11 @@ class RouterServer:
                          f"(keys={n_keys} "
                          f"inflight_keys={n_inflight_keys})")
         for name, routed, infl in rows:
-            lines.append(f"  replica {name}: "
-                         f"{'DEAD' if name in dead else 'up'} "
+            state = "DEAD" if name in dead else "up"
+            if name in cordoned:
+                state += " CORDONED" + (" draining" if infl else
+                                        " drained")
+            lines.append(f"  replica {name}: {state} "
                          f"routed={routed} inflight={infl}")
         if self.alerts is not None:
             arep = self.alerts.report()
@@ -1723,6 +1862,14 @@ class RouterServer:
             rec = self.advisor.recommend()
             lines.append(f"  advice: {rec['action']} n={rec['n']} "
                          f"({rec['reason']})")
+        asc = self.autoscaler
+        if asc is not None:
+            arep = asc.report()
+            last = arep["last_action"]
+            lines.append(
+                f"  autoscaler: epoch={arep['epoch']['generation']} "
+                f"size={arep['size']} draining={arep['draining']}"
+                + (f" last={last['action']}" if last else ""))
         sup = self.supervisor
         if sup is not None:
             for name, st in sorted(sup.state().items()):
@@ -1742,11 +1889,15 @@ class RouterServer:
         with self._lock:
             for r in self.replicas:
                 shadow = self._shadows[r.name]
+                infl = self._inflight.get(r.name, 0)
                 out.append({
                     "name": r.name,
                     "healthy": r.name not in self._dead,
+                    "cordoned": r.name in self._cordoned,
+                    "draining": (r.name in self._cordoned
+                                 and infl > 0),
                     "routed": self._routed.get(r.name, 0),
-                    "inflight": self._inflight.get(r.name, 0),
+                    "inflight": infl,
                     "view": dict(self._views.get(r.name, {}),
                                  prefix=None),
                     "shadow_paths": len(shadow),

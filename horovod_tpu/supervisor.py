@@ -203,8 +203,12 @@ class ReplicaSupervisor:
         poll): attempt a respawn for every dead, budgeted, backed-off
         replica.  Returns how many replicas rejoined."""
         with self.router._lock:
+            # A cordoned replica is being drained out of the fleet by
+            # the autoscaler: if it dies mid-drain its in-flight work
+            # fails over, but respawning it would fight the retire.
             dead = [r for r in self.router.replicas
-                    if r.name in self.router._dead]
+                    if r.name in self.router._dead
+                    and r.name not in self.router._cordoned]
         rejoined = 0
         for handle in dead:
             if self._respawn(handle):
@@ -266,6 +270,43 @@ class ReplicaSupervisor:
             return False    # out-of-band: probes will revive the handle
         self.router.replace_replica(name, replacement)
         return True
+
+    # -- elastic membership (the autoscaler's factory seam) ----------------
+
+    def spawn_replica(self, name: str,
+                      template: "ReplicaHandle | None" = None,
+                      ) -> "ReplicaHandle | None":
+        """Build a brand-new replica handle for the autoscaler's grow
+        path, through the same pluggable factory seam respawn uses: an
+        explicit ``factories[name]`` entry wins; otherwise a live
+        local replica (``template``, or the first healthy
+        :class:`~horovod_tpu.router.LocalReplica`) is cloned via
+        :func:`clone_engine` and pre-warmed with its hot prompts.
+        Returns ``None`` when no factory applies (an all-HTTP fleet
+        grows out-of-band)."""
+        fac = self.factories.get(name)
+        if fac is not None:
+            return fac()
+        if template is None:
+            with self.router._lock:
+                live = [r for r in self.router.replicas
+                        if r.name not in self.router._dead
+                        and isinstance(r, LocalReplica)]
+            template = live[0] if live else None
+        if not isinstance(template, LocalReplica):
+            return None
+        eng = clone_engine(template.engine)
+        # Warm from the template's shadow: the newcomer inherits the
+        # fleet's hot prefixes instead of joining with a cold radix.
+        self._warm(eng, template.name)
+        return LocalReplica(eng, name=name, faults=template.faults)
+
+    def forget(self, name: str) -> None:
+        """Drop a retired replica's restart record so a future replica
+        reusing the name starts with a full budget (the autoscaler
+        calls this after :meth:`~horovod_tpu.router.RouterServer.retire_replica`)."""  # noqa: E501
+        with self._lock:
+            self._records.pop(name, None)
 
     # -- warm respawn ------------------------------------------------------
 

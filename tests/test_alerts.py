@@ -535,9 +535,13 @@ def test_advisor_scales_down_when_fleet_fits_fewer_replicas():
                 for i in range(1, 7)}
     reg, adv = _advised(gauges, counters, knee=knee)
     rec = adv.recommend()
-    # 0.5 rps < knee * low_util * (n-1) = 2 * 0.3 * 2 = 1.2.
-    assert rec["action"] == "scale_down" and rec["n"] == 1
-    assert reg.snapshot()["gauges"]["advisor.target_delta"] == -1
+    # Trigger: 0.5 rps < knee * low_util * (n-1) = 2 * 0.3 * 2 = 1.2.
+    # Demand-sized: ceil(0.5 / (2 * 0.8 headroom)) = 1 replica needed,
+    # 3 healthy -> -2 (one survivor floor keeps it from -3).
+    assert rec["action"] == "scale_down" and rec["n"] == 2
+    assert "fits 1 replica" in rec["reason"]
+    assert rec["evidence"]["headroom"] == 0.8
+    assert reg.snapshot()["gauges"]["advisor.target_delta"] == -2
 
 
 def test_advisor_holds_inside_the_envelope():

@@ -10,9 +10,11 @@ render the SAME alert history:
 Live mode scrapes ``/alerts`` (MonitorServer or RouterServer — both
 serve it) plus ``/advice`` when an advisor is attached; replay mode
 reads the structured event log (rotation-aware: a ``<path>.1``
-generation is read first, torn lines are skipped) and keeps only the
-``alert.*`` transition records the AlertManager emitted.  Either way
-the result is a normalized timeline of
+generation is read first, torn lines are skipped) and keeps the
+``alert.*`` transition records the AlertManager emitted plus the
+``autoscaler.*`` action records (scale-ups, cordons, forced drains,
+retires) so the timeline shows what the fleet did between pages.
+Either way the result is a normalized timeline of
 ``{t, rule, event, state, severity, value}`` rows.
 
 Regression gate (the ``profile_report.py --compare`` contract — two
@@ -60,10 +62,25 @@ def read_events(path: str):
 def timeline_from_events(events) -> list[dict]:
     """Normalized alert timeline from replayed event-log records
     (``kind`` = ``alert.fire`` / ``alert.pending`` / ``alert.cancel``
-    / ``alert.resolve``)."""
+    / ``alert.resolve``), with autoscaler actions (``kind`` =
+    ``autoscaler.scale_up`` / ``.cordon`` / ``.drain_force`` /
+    ``.retire`` / ``.hold`` / …) interleaved so the rendered timeline
+    shows what the fleet DID between the pages.  Autoscaler rows carry
+    ``plane="autoscale"`` and are excluded from :func:`timeline_key`
+    — the live-scrape ≡ event-replay equivalence contract is about
+    alert transitions, which the ``/alerts`` payload alone carries."""
     rows = []
     for e in events:
         kind = e.get("kind", "")
+        if kind.startswith("autoscaler."):
+            rows.append({"t": e.get("ts"), "rule": "autoscaler",
+                         "event": kind[len("autoscaler."):],
+                         "state": (e.get("replica") or e.get("advice")
+                                   or "-"),
+                         "severity": "info",
+                         "value": e.get("epoch"),
+                         "plane": "autoscale"})
+            continue
         if not kind.startswith("alert."):
             continue
         rows.append({"t": e.get("ts"), "rule": e.get("rule"),
@@ -86,8 +103,10 @@ def timeline_from_alerts(report: dict) -> list[dict]:
 def timeline_key(timeline: list[dict]) -> list[tuple]:
     """The timestamp-free equivalence key: live scrape and event-log
     replay of one run must agree on this exactly (timestamps differ by
-    emit latency; the transition sequence must not)."""
-    return [(r["rule"], r["event"], r["state"]) for r in timeline]
+    emit latency; the transition sequence must not).  Autoscaler rows
+    are replay-only context, so they stay out of the key."""
+    return [(r["rule"], r["event"], r["state"]) for r in timeline
+            if r.get("plane", "alert") == "alert"]
 
 
 def scrape(url: str) -> dict:
