@@ -738,6 +738,67 @@ def _bench_serve_spec(hvd, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_serve_tp(hvd, on_tpu: bool) -> dict:
+    """Tensor-parallel serving arm (extras, TPU only): one ServeEngine
+    per tp in {1, 2, 4} on the same shared-prefix workload, reporting
+    per-tp tokens/s and per-chip scaling efficiency
+    (``serve_tp{N}_tokens_per_sec`` / ``serve_tp{N}_scaling_eff``).
+    Parity is asserted inside the helper — every tp size emits
+    identical tokens, so the ratios price pure mesh mechanics.  On the
+    CPU rehearsal the faked devices share host cores, so efficiency
+    reads as collective overhead only (expected << 1); the real per-chip
+    curve comes from a TPU window, where tp also multiplies KV capacity
+    (the headline: N-chip HBM per replica)."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_tp_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config with a 4-way-divisible
+        # KV-head axis, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32,
+                               n_kv_heads=4)
+        n_slots, max_len, chunk = 2, 32, 4
+        n_reqs, prompt_len, new_toks = 4, 6, 12
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        n_reqs, prompt_len, new_toks = 16, 48, 96
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(31)
+    stem = [int(t) for t in rng.randint(1, cfg.vocab_size,
+                                        size=prompt_len - 1)]
+    reqs = [Request(prompt=stem + [int(t)], max_new_tokens=new_toks)
+            for t in rng.randint(1, cfg.vocab_size, size=n_reqs)]
+    r = measure_tp_throughput(params, cfg, reqs, n_slots=n_slots,
+                              max_len=max_len, chunk=chunk,
+                              tp_sizes=(1, 2, 4), prefix_cache=True)
+    out: dict = {
+        "serve_tp_sizes": r["serve_tp_sizes"],
+        "serve_tp_shape": (
+            f"s{n_slots}_len{max_len}_chunk{chunk}_"
+            f"new{new_toks}_req{n_reqs}"),
+    }
+    for tp in r["serve_tp_sizes"]:
+        out[f"serve_tp{tp}_tokens_per_sec"] = round(
+            r[f"serve_tp{tp}_tokens_per_sec"], 1)
+        out[f"serve_tp{tp}_scaling_eff"] = round(
+            r[f"serve_tp{tp}_scaling_eff"], 3)
+    if r["serve_tp_skipped"]:
+        out["serve_tp_skipped"] = r["serve_tp_skipped"]
+    return out
+
+
 def _bench_serve_router(hvd, on_tpu: bool) -> dict:
     """Multi-replica router arm (extras, TPU only): a shared-prefix
     workload served through the RouterServer over an in-process fleet,
@@ -1466,7 +1527,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
     # newer arms.
     for fn in (_bench_fusion, _bench_serving,
                _bench_serving_overcommit, _bench_serve_prefix,
-               _bench_serve_spec, _bench_serve_router,
+               _bench_serve_spec, _bench_serve_tp, _bench_serve_router,
                _bench_serve_chaos, _bench_serve_load,
                _bench_serve_autoscale,
                _bench_resnet101_big_batch,

@@ -149,6 +149,8 @@ ENV_KNOBS = (
      "Base respawn delay for a dead replica (doubles per restart)."),
     ("HVD_TPU_SUPERVISE_MAX_RESTARTS", "3",
      "Respawns per replica before the supervisor circuit-breaks it."),
+    ("HVD_TPU_TP", "1",
+     "Tensor-parallel degree of ServeEngine (chips per serving replica)."),
     ("HVD_TPU_VERIFY_BLOCKS", "0",
      "Walk paged-KV block tables every serve tick (debug, slow)."),
 )

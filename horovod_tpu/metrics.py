@@ -177,6 +177,16 @@ METRIC_HELP: dict[str, str] = {
     "kv.cached_bytes": "Zero-ref KV pool bytes parked in the prefix cache",
     "kv.block_bytes": "Device bytes one KV block holds (k+v, all layers)",
     "kv.total_bytes": "Device bytes of the whole paged KV pool (incl. trash)",
+    # kv.shard_* / tp.* — per-chip view of the same pool under
+    # tensor-parallel serving (logical bytes / tp.size: the pool is
+    # head-split, block counts are per-chip already).  Always emitted;
+    # equal to the logical kv.* bytes at tp.size = 1.
+    "kv.shard_block_bytes": "Per-chip device bytes of one KV block (logical / tp.size)",
+    "kv.shard_total_bytes": "Per-chip device bytes of the paged KV pool (logical / tp.size)",
+    "kv.shard_free_bytes": "Per-chip KV pool bytes on the free list",
+    "kv.shard_referenced_bytes": "Per-chip KV pool bytes mapped by live rows",
+    "kv.shard_cached_bytes": "Per-chip KV pool bytes parked in the prefix cache",
+    "tp.size": "Tensor-parallel degree of the serving engine (chips per replica)",
     # mem.* — host-side observability footprint (approximate)
     "mem.registry_bytes": "Approximate host bytes held by the metrics registry",
     "mem.trace_ring_bytes": "Approximate host bytes of live traces + the SLO ring",

@@ -153,6 +153,26 @@ def param_partition_specs(cfg: LlamaConfig, *, tp_axis: str = "tp") -> dict:
     }
 
 
+def paged_cache_partition_specs(*, tp_axis: str = "tp") -> "PagedKVCache":
+    """Head-sharded layout for the paged KV pool over ``tp_axis``.
+
+    k/v ``[n_layers, n_blocks, block_size, KVH, Dh]`` shard on the KV-head
+    axis — the same heads the column-parallel wk/wv produce locally, so a
+    sharded decode writes its own head slice with zero cross-chip traffic
+    and the per-chip pool holds ``KVH / tp`` heads (KV HBM split across
+    chips).  ``block_table``/``length`` stay replicated: block ids are
+    host-side bookkeeping, one logical block id addresses the same slot of
+    every chip's head slice, which is what keeps the BlockPool / radix
+    prefix cache / preemption replay shard-agnostic.
+    """
+    return PagedKVCache(
+        k=P(None, None, None, tp_axis, None),
+        v=P(None, None, None, tp_axis, None),
+        block_table=P(),
+        length=P(),
+    )
+
+
 def rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)

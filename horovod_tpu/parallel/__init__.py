@@ -12,7 +12,11 @@ from horovod_tpu.parallel.attention import (  # noqa: F401
     zigzag_unshard,
 )
 from horovod_tpu.parallel.flash_attention import flash_attention  # noqa: F401
-from horovod_tpu.parallel.mesh import data_parallel_mesh, make_mesh  # noqa: F401
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    data_parallel_mesh,
+    make_mesh,
+    tensor_parallel_mesh,
+)
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_forward,
     pipeline_loss_fn,

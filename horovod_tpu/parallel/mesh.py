@@ -37,11 +37,17 @@ def make_mesh(
     devs = list(devices) if devices is not None else jax.devices()
     shape = [dcn_slices, pp, dp, ep, sp, tp]
     names = ["dcn", "pp", "dp", "ep", "sp", "tp"]
+    for name, size in zip(names, shape):
+        if size < 1:
+            raise ValueError(
+                f"mesh axis {name!r} must be >= 1, got {size}"
+            )
     total = int(np.prod(shape))
     if len(devs) != total:
         raise ValueError(
-            f"mesh axes {dict(zip(names, shape))} need {total} devices, "
-            f"have {len(devs)}"
+            f"mesh axes {dict(zip(names, shape))} need "
+            f"{total} devices (product of axis sizes), have {len(devs)}: "
+            f"device count must equal the axis product exactly"
         )
     if dcn_slices == 1:
         shape, names = shape[1:], names[1:]
@@ -74,4 +80,33 @@ def data_parallel_mesh(devices=None) -> Mesh:
     from horovod_tpu.basics import AXIS_NAME
 
     devs = np.asarray(devices if devices is not None else jax.devices())
+    if devs.ndim != 1 or devs.size == 0:
+        raise ValueError(
+            f"data_parallel_mesh needs a non-empty flat device list, got "
+            f"{devs.size} devices with shape {tuple(devs.shape)}"
+        )
     return Mesh(devs, (AXIS_NAME,))
+
+
+def tensor_parallel_mesh(
+    tp_size: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Serving-facing 1-axis ``('tp',)`` mesh over ``tp_size`` devices.
+
+    The inference engine shards attention heads / MLP columns / the paged
+    KV pool over this one axis (``models/llama.py`` partition specs);
+    keeping the mesh 1-D means one replica == one tp group and the block
+    pool stays host-side and shard-agnostic.  Uses the first ``tp_size``
+    devices — on a real slice those are ICI neighbors by enumeration
+    order, on a faked CPU host they are the virtual devices.
+    """
+    if tp_size < 1:
+        raise ValueError(f"tensor_parallel_mesh needs tp_size >= 1, got {tp_size}")
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < tp_size:
+        raise ValueError(
+            f"tensor_parallel_mesh(tp_size={tp_size}) needs {tp_size} "
+            f"devices, have {len(devs)}"
+        )
+    return Mesh(np.asarray(devs[:tp_size]), ("tp",))

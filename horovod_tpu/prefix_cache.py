@@ -47,6 +47,14 @@ on admission.  This module is both, mapped onto the existing
 The whole subsystem is host-side bookkeeping; parity is exact by
 construction (same KV values at the same positions, same programs), and
 is pinned by ``tests/test_prefix_cache.py`` against cache-off runs.
+
+Because it only ever deals in *logical* block ids, the index is also
+**shard-agnostic**: under tensor-parallel serving
+(``ServeEngine(tp_size=N)``) the paged pool is head-split across the
+``('tp',)`` mesh and one block id addresses the same slot of every
+chip's head slice, so matching, release-to-cache, COW, and eviction
+work over a sharded pool unchanged (pinned by
+``tests/test_serving_tp.py``).
 """
 
 from __future__ import annotations

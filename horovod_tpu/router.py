@@ -331,8 +331,10 @@ class ReplicaHandle:
 
     def probe(self) -> dict:
         """Poller view: ``healthy``, ``inflight``, ``queue_depth``,
-        ``goodput``, ``free_kv_frac``, and optionally ``prefix`` (a
-        ``key_digest()`` summary)."""
+        ``goodput``, ``free_kv_frac``, ``tp_size`` (chips behind this
+        replica — capacity accounting for multi-chip replicas; its
+        ``free_kv_frac`` is a fraction of an N-chip logical pool), and
+        optionally ``prefix`` (a ``key_digest()`` summary)."""
         raise NotImplementedError
 
     def stop(self) -> None:
@@ -377,7 +379,9 @@ class LocalReplica(ReplicaHandle):
         self._stop = False
         self._view: dict = {"healthy": True, "inflight": 0,
                             "queue_depth": 0, "goodput": 1.0,
-                            "free_kv_frac": 1.0, "prefix": None}
+                            "free_kv_frac": 1.0,
+                            "tp_size": getattr(engine, "tp_size", 1),
+                            "prefix": None}
         self._thread = threading.Thread(
             target=self._pump, name=f"hvd-replica-{name}", daemon=True)
         self._thread.start()
@@ -414,6 +418,7 @@ class LocalReplica(ReplicaHandle):
             "queue_depth": len(self._cbs),
             "goodput": eng.slo.goodput(),
             "free_kv_frac": free / total,
+            "tp_size": getattr(eng, "tp_size", 1),
             "prefix": (eng.prefix.key_digest()
                        if eng.prefix is not None else None),
         }
@@ -572,7 +577,8 @@ class HttpReplica(ReplicaHandle):
     def probe(self) -> dict:
         view: dict[str, Any] = {"healthy": False, "inflight": 0,
                                 "queue_depth": 0, "goodput": 1.0,
-                                "free_kv_frac": 1.0, "prefix": None}
+                                "free_kv_frac": 1.0, "tp_size": 1,
+                                "prefix": None}
         if self.monitor_url is None:
             view["healthy"] = True      # no monitor: assume alive
             return view
@@ -594,6 +600,7 @@ class HttpReplica(ReplicaHandle):
         if total > 0:
             view["free_kv_frac"] = (g.get("kv.free_blocks", 0)
                                     + g.get("kv.cached_blocks", 0)) / total
+        view["tp_size"] = int(g.get("tp.size", 1)) or 1
         view["prefix"] = snap.get("prefix")
         return view
 

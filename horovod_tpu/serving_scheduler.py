@@ -131,6 +131,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from horovod_tpu import alerts as alerts_mod
 from horovod_tpu import drafting as drafting_mod
@@ -142,6 +143,7 @@ from horovod_tpu import scheduling as scheduling_mod
 from horovod_tpu import timeseries as timeseries_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
+from horovod_tpu.parallel.mesh import tensor_parallel_mesh
 from horovod_tpu.prefix_cache import RadixPrefixCache
 from horovod_tpu.serving import (
     CANCELLED, FAILED, OK, REJECTED, TIMEOUT, Request, RequestResult,
@@ -290,12 +292,25 @@ class ServeEngine:
     ``HVD_TPU_SCHED_POLICY``.  FIFO is bit-compatible with the
     pre-policy engine; policies reorder who waits and who is evicted,
     never any request's tokens (scheduler invariant 2).
+
+    ``tp_size``: tensor-parallel serving — the decode path runs on a
+    1-axis ``('tp',)`` device mesh
+    (:func:`~horovod_tpu.parallel.mesh.tensor_parallel_mesh`) with
+    params Megatron-split and the paged KV pool head-split, so KV HBM
+    and the matmul work divide across ``tp_size`` chips while the
+    block pool / prefix cache / block tables stay host-side and
+    shard-agnostic.  Greedy outputs are token-identical to the
+    unsharded engine and ``compile_cache_sizes()`` stays at one
+    signature per program.  ``None`` reads ``HVD_TPU_TP`` (default
+    1); at 1 there is no mesh and every code path is the
+    single-device one.
     """
 
     def __init__(self, params: dict, cfg: llama.LlamaConfig, *,
                  n_slots: int, max_len: int, chunk: int,
                  block_size: int | None = None,
                  n_blocks: int | None = None,
+                 tp_size: int | None = None,
                  timeline: Any = None,
                  preempt_after: int | None = None,
                  max_retries: int = 2,
@@ -325,6 +340,50 @@ class ServeEngine:
         if watchdog_steps < 1:
             raise ValueError("watchdog_steps must be >= 1")
         block_size = chunk if block_size is None else block_size
+        # Tensor-parallel serving: tp_size > 1 puts the decode path on a
+        # 1-axis ('tp',) mesh — params Megatron-split per
+        # llama.param_partition_specs, the paged KV pool head-split per
+        # llama.paged_cache_partition_specs — while the block pool /
+        # prefix cache / block tables stay host-side and shard-agnostic
+        # (one logical block id addresses the same slot of every chip's
+        # head slice).  None reads HVD_TPU_TP (default 1); at tp_size=1
+        # no mesh exists and every code path is the single-device one.
+        if tp_size is None:
+            raw = os.environ.get("HVD_TPU_TP", "")
+            tp_size = int(raw) if raw else 1
+        tp_size = int(tp_size)
+        if tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {tp_size}")
+        if tp_size > 1:
+            for dim_name, dim in (("n_heads", cfg.n_heads),
+                                  ("n_kv_heads", cfg.n_kv_heads),
+                                  ("dim", cfg.dim),
+                                  ("ffn_dim", cfg.ffn_dim),
+                                  ("vocab_size", cfg.vocab_size)):
+                if dim % tp_size:
+                    raise ValueError(
+                        f"tp_size={tp_size} does not divide "
+                        f"cfg.{dim_name}={dim}: every tp-sharded axis "
+                        f"must split evenly across the mesh")
+        self.tp_size = tp_size
+        if tp_size > 1:
+            self.mesh = tensor_parallel_mesh(tp_size)
+            pspecs = llama.param_partition_specs(cfg, tp_axis="tp")
+            cspecs = llama.paged_cache_partition_specs(tp_axis="tp")
+            self._param_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), pspecs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+            self._cache_sh = llama.PagedKVCache(
+                *(NamedSharding(self.mesh, s) for s in cspecs))
+            self._repl_sh = NamedSharding(self.mesh, PartitionSpec())
+            # Pre-commit the persistent state to its exact target
+            # sharding: jit cache keys distinguish committed from
+            # uncommitted inputs, so an uncommitted first call would
+            # mint a second signature and trip the retrace sentry.
+            params = jax.tree.map(jax.device_put, params, self._param_sh)
+        else:
+            self.mesh = None
+            self._param_sh = self._cache_sh = self._repl_sh = None
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -429,6 +488,10 @@ class ServeEngine:
         self.pcache = llama.init_paged_cache(
             cfg, n_slots, max_len, block_size=block_size,
             n_blocks=n_blocks)
+        if self.tp_size > 1:
+            self.pcache = llama.PagedKVCache(*(
+                jax.device_put(x, s)
+                for x, s in zip(self.pcache, self._cache_sh)))
         self.blocks_per_slot = self.pcache.block_table.shape[1]
         total = self.pcache.k.shape[1]
         # block 0 is trash — never allocated; the pool's free list pops
@@ -447,6 +510,18 @@ class ServeEngine:
         self.metrics.gauge("kv.block_bytes").set(self._block_bytes)
         self.metrics.gauge("kv.total_bytes").set(
             self._block_bytes * total)
+        # Per-shard KV accounting: each chip holds n_kv_heads / tp of
+        # every block (head-split pool), so shard bytes are the logical
+        # bytes over tp — exact, the head axis divides evenly (checked
+        # above).  Uniform schema: at tp_size=1 shard gauges equal the
+        # logical ones, and the tp gauges always exist so scrapes and
+        # router capacity probes never branch on engine flavor.
+        self._shard_block_bytes = self._block_bytes // self.tp_size
+        self.metrics.gauge("tp.size").set(self.tp_size)
+        self.metrics.gauge("kv.shard_block_bytes").set(
+            self._shard_block_bytes)
+        self.metrics.gauge("kv.shard_total_bytes").set(
+            self._shard_block_bytes * total)
         self.prefix = (RadixPrefixCache(self.pool, block_size,
                                         metrics=self.metrics)
                        if prefix_cache else None)
@@ -457,6 +532,9 @@ class ServeEngine:
         self._trash_row = np.zeros((self.blocks_per_slot,), np.int32)
         self.last_logits = jnp.zeros((n_slots, cfg.vocab_size),
                                      jnp.float32)
+        if self.tp_size > 1:
+            self.last_logits = jax.device_put(self.last_logits,
+                                              self._repl_sh)
         self._slots = [_Slot() for _ in range(n_slots)]
         self._queue: list[_QueueEntry] = []
         self._next_id = 0
@@ -472,7 +550,28 @@ class ServeEngine:
                          "retries": 0, "failures": 0}
         self.step_index = 0
 
-        @partial(jax.jit, donate_argnums=(1, 2))
+        # Sharded program signatures: explicit in/out shardings pin the
+        # GSPMD layout at every jit boundary (params Megatron-split, KV
+        # pool head-split, everything the host reads replicated) — XLA
+        # then keeps Q·Kᵀ and the MLP matmuls chip-local with one psum
+        # per attention/MLP block (the row-parallel wo/w_down reduction)
+        # and tp>1 stays at one signature per program.  At tp_size=1 the
+        # kwargs are empty and the decorators are byte-identical to the
+        # single-device engine.
+        if self.tp_size > 1:
+            _p, _c, _r = self._param_sh, self._cache_sh, self._repl_sh
+            _tick_sh = dict(in_shardings=(_p, _c, _r, _r),
+                            out_shardings=(_r, _r, _c))
+            _chunk_sh = dict(in_shardings=(_p, _c, _r, _r, _r, _r, _r),
+                             out_shardings=(_c, _r))
+            _row_sh = dict(in_shardings=(_c, _r, _r, _r),
+                           out_shardings=_c)
+            _spec_sh = dict(in_shardings=(_p, _c, _r, _r, _r),
+                            out_shardings=(_r, _r, _r, _c))
+        else:
+            _tick_sh = _chunk_sh = _row_sh = _spec_sh = {}
+
+        @partial(jax.jit, donate_argnums=(1, 2), **_tick_sh)
         def _tick(params, pcache, last_logits, active):
             # the fixed-signature decode tick: every row argmaxes its
             # last logits and decodes one position; `active` [B] gates
@@ -485,7 +584,7 @@ class ServeEngine:
                 params, tok[:, None], cfg, pcache, advance=active)
             return tok, logits[:, 0], pcache
 
-        @partial(jax.jit, donate_argnums=(1, 2))
+        @partial(jax.jit, donate_argnums=(1, 2), **_chunk_sh)
         def _chunk(params, pcache, last_logits, toks, slot, new_len, sel):
             # one chunked-prefill window for one slot: [1, chunk] tokens
             # continue the row from its current length; `sel` picks the
@@ -496,7 +595,7 @@ class ServeEngine:
             last_logits = last_logits.at[slot].set(logits[0, sel])
             return pcache, last_logits
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0,), **_row_sh)
         def _set_row(pcache, slot, row, length):
             # admission/retirement table write: swaps which physical
             # blocks a slot row maps to and sets its length — data
@@ -510,7 +609,7 @@ class ServeEngine:
                 length=pcache.length.at[slot].set(length))
 
         if self.spec:
-            @partial(jax.jit, donate_argnums=(1, 2))
+            @partial(jax.jit, donate_argnums=(1, 2), **_spec_sh)
             def _spec_tick(params, pcache, last_logits, drafts, active):
                 # the always-wide speculative tick: one (draft_k+1)-wide
                 # verify for the whole pool, acceptance and the gated
@@ -599,6 +698,7 @@ class ServeEngine:
         referenced = self.pool.ref_count()
         cached = self.pool.cached_count()
         bb = self._block_bytes
+        sbb = self._shard_block_bytes
         kv = {
             "block_bytes": bb,
             "total_bytes": bb * self.pcache.k.shape[1],
@@ -606,6 +706,15 @@ class ServeEngine:
             "referenced_blocks": referenced,
             "referenced_bytes": referenced * bb,
             "cached_blocks": cached, "cached_bytes": cached * bb,
+            # per-chip view of the same pool (logical / tp_size; block
+            # *counts* are per-chip already — every chip maps every
+            # block, each holding its own head slice)
+            "tp_size": self.tp_size,
+            "shard_block_bytes": sbb,
+            "shard_total_bytes": sbb * self.pcache.k.shape[1],
+            "shard_free_bytes": free * sbb,
+            "shard_referenced_bytes": referenced * sbb,
+            "shard_cached_bytes": cached * sbb,
         }
         # host side: getsizeof-level approximations — trend lines for
         # leak spotting, not byte-exact accounting
@@ -631,6 +740,10 @@ class ServeEngine:
         self.metrics.gauge("kv.referenced_bytes").set(referenced * bb)
         self.metrics.gauge("kv.cached_blocks").set(cached)
         self.metrics.gauge("kv.cached_bytes").set(cached * bb)
+        self.metrics.gauge("kv.shard_free_bytes").set(free * sbb)
+        self.metrics.gauge("kv.shard_referenced_bytes").set(
+            referenced * sbb)
+        self.metrics.gauge("kv.shard_cached_bytes").set(cached * sbb)
         self.metrics.gauge("mem.registry_bytes").set(
             host["registry_bytes"])
         self.metrics.gauge("mem.trace_ring_bytes").set(trace_ring)
@@ -685,7 +798,10 @@ class ServeEngine:
             f"  kv bytes: block={bb} free={self.pool.free_count() * bb}"
             f" referenced={self.pool.ref_count() * bb}"
             f" cached={self.pool.cached_count() * bb}"
-            f" total={bb * self.pcache.k.shape[1]}")
+            f" total={bb * self.pcache.k.shape[1]}"
+            f" tp_size={self.tp_size}"
+            f" shard_total="
+            f"{self._shard_block_bytes * self.pcache.k.shape[1]}")
         if self.prof is not None:
             rep = self.prof.report()
             lines.append(
@@ -1496,6 +1612,11 @@ class ServeEngine:
         self.metrics.gauge("kv.referenced_bytes").set(ref_b * bb)
         self.metrics.gauge("kv.cached_blocks").set(cached_b)
         self.metrics.gauge("kv.cached_bytes").set(cached_b * bb)
+        sbb = self._shard_block_bytes
+        self.metrics.gauge("kv.shard_free_bytes").set(free_b * sbb)
+        self.metrics.gauge("kv.shard_referenced_bytes").set(
+            ref_b * sbb)
+        self.metrics.gauge("kv.shard_cached_bytes").set(cached_b * sbb)
         # Retrace sentry: a jit cache that grows past one signature per
         # program mid-serve means some host value leaked into a traced
         # shape/dtype — the exact regression HVD001 lints for statically.
@@ -1898,3 +2019,79 @@ def measure_spec_throughput(
         "max_len": max_len,
         "chunk": chunk,
     }
+
+
+def measure_tp_throughput(
+    params: dict, cfg: llama.LlamaConfig, requests: list[Request], *,
+    n_slots: int, max_len: int, chunk: int,
+    block_size: int | None = None, n_blocks: int | None = None,
+    tp_sizes: tuple[int, ...] = (1, 2, 4),
+    prefix_cache: bool = False,
+    spec: bool | None = None,
+) -> dict:
+    """Tensor-parallel throughput sweep on one workload (the
+    ``serve_tp_*`` bench metrics).
+
+    One engine per ``tp_size``, each warmed by a full untimed pass
+    (compiles every sharded program) and timed on a second pass over
+    the same queue.  Outputs are asserted token-identical across every
+    tp size (the sharded-parity guarantee), so the ratios price pure
+    mesh mechanics.  Returns per-tp ``serve_tp{N}_tokens_per_sec`` and
+    ``serve_tp{N}_scaling_eff`` — tokens/s relative to tp=1 divided by
+    N, the per-chip scaling efficiency (1.0 = linear; on a faked-CPU
+    rehearsal this prices collective overhead only, real ICI numbers
+    come from a TPU window) — plus ``serve_tp_sizes`` actually run and
+    workload shape fields.  tp entries whose size exceeds the device
+    count (or does not divide the head/ffn/vocab axes) are skipped and
+    listed under ``serve_tp_skipped``.
+    """
+    if not requests:
+        raise ValueError("empty workload")
+    kw = dict(n_slots=n_slots, max_len=max_len, chunk=chunk,
+              block_size=block_size, n_blocks=n_blocks,
+              prefix_cache=prefix_cache, spec=spec,
+              metrics=metrics_mod.NULL)
+    timings: dict[int, float] = {}
+    outputs: dict[int, list[RequestResult]] = {}
+    skipped: list[int] = []
+    n_tokens = 0
+    for tp in tp_sizes:
+        if tp > jax.device_count() or any(
+                d % tp for d in (cfg.n_heads, cfg.n_kv_heads, cfg.dim,
+                                 cfg.ffn_dim, cfg.vocab_size)):
+            skipped.append(tp)
+            continue
+        eng = ServeEngine(params, cfg, tp_size=tp, **kw)
+        warm = eng.run(requests)
+        assert all(r.ok for r in warm), [r.status for r in warm]
+        n_tokens = sum(len(t) for t in warm)
+        t0 = time.perf_counter()
+        out = eng.run(requests)
+        jax.block_until_ready(eng.pcache.k)
+        timings[tp] = time.perf_counter() - t0
+        outputs[tp] = out
+    ran = sorted(timings)
+    if not ran:
+        raise ValueError(
+            f"no tp size in {tp_sizes} fits {jax.device_count()} "
+            f"devices and the model's sharded axes")
+    base = ran[0]
+    for tp in ran[1:]:
+        assert [list(a) for a in outputs[tp]] == \
+            [list(b) for b in outputs[base]], \
+            f"tensor-parallel parity broken at tp={tp}"
+    result: dict[str, Any] = {
+        "serve_tp_sizes": ran,
+        "serve_tp_skipped": skipped,
+        "tokens": n_tokens,
+        "n_requests": len(requests),
+        "n_slots": n_slots,
+        "max_len": max_len,
+        "chunk": chunk,
+    }
+    for tp in ran:
+        tps = n_tokens / timings[tp]
+        result[f"serve_tp{tp}_tokens_per_sec"] = tps
+        result[f"serve_tp{tp}_scaling_eff"] = (
+            tps / (n_tokens / timings[base])) / (tp / base)
+    return result

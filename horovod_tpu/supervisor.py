@@ -78,6 +78,7 @@ def clone_engine(eng: Any) -> Any:
         # The paged cache's axis-1 extent IS n_blocks (trash block
         # included), so the clone's KV geometry matches bit-for-bit.
         n_blocks=int(eng.pcache.k.shape[1]),
+        tp_size=eng.tp_size,
         timeline=eng.timeline,
         preempt_after=eng.preempt_after,
         max_retries=eng.max_retries,

@@ -55,6 +55,18 @@ def _hvd_world():
     hvd.shutdown()
 
 
+@pytest.fixture
+def tp_devices(_hvd_world):
+    """Devices for `tp`-marked sharded-serving tests.  The conftest
+    already forces an 8-virtual-device CPU mesh; if a stray XLA_FLAGS
+    ordering (or a real single-chip backend) left fewer than 2 devices,
+    skip instead of failing — the subprocess worker test still covers
+    the sharded path by re-exec'ing with the flag forced."""
+    if jax.device_count() < 2:
+        pytest.skip("tensor-parallel tests need >= 2 (faked) devices")
+    return jax.devices()
+
+
 @pytest.fixture(autouse=True)
 def _ensure_world(_hvd_world):
     """Re-init the full world if a prior test (or an in-process example
