@@ -1805,7 +1805,34 @@ def _preserved_window_artifact() -> dict | None:
     return None
 
 
+def _lint_preflight() -> None:
+    """`python -m tools.hvdlint --json` smoke before spending the TPU
+    window: a broken checker or a dirty tree fails loudly up front
+    (note + nonzero summary in stderr) instead of surfacing as a
+    mystery in the post-run tier-1 gate.  Advisory only — lint debt
+    must not cost a benchmark round."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.hvdlint", "--json"],
+            cwd=here, capture_output=True, text=True, timeout=60)
+        summary = json.loads(out.stdout)["summary"]
+    except Exception as exc:  # noqa: BLE001 — smoke must never raise
+        _note(f"LINT PREFLIGHT BROKEN: hvdlint --json did not produce "
+              f"its schema ({exc!r}) — the linter itself is damaged")
+        return
+    if out.returncode != 0 or not summary.get("ok", False):
+        _note(f"LINT PREFLIGHT FAILED: hvdlint reports "
+              f"{summary.get('active')} active finding(s), "
+              f"{summary.get('stale_baseline')} stale baseline "
+              f"entr(ies) — run `python -m tools.hvdlint` locally")
+    else:
+        _note(f"lint preflight ok ({summary.get('files_scanned')} files)")
+
+
 def _orchestrate() -> None:
+    _lint_preflight()
     hard_limit = float(os.environ.get("HVD_TPU_BENCH_HARD_LIMIT", "840"))
     claim_timeout = float(os.environ.get("HVD_TPU_BENCH_CLAIM_TIMEOUT", "60"))
     attempts = int(os.environ.get("HVD_TPU_BENCH_PROBE_ATTEMPTS", "5"))

@@ -107,6 +107,29 @@ LIFECYCLE_EVENT_COUNTERS: dict[str, str] = {
     "serve.fail": "failures",
 }
 
+#: Declared bit-identity replay surfaces: code paths whose output must
+#: be byte-for-byte reproducible from their inputs (journal entries, a
+#: seed, a snapshot) because something downstream replays or diffs it.
+#: ``tools/hvdlint`` (HVD010) walks each ``(surface, path, qualname,
+#: note)`` row's same-file call closure and flags wall-clock reads,
+#: unseeded entropy, and set-iteration-order dependence.  A new replay
+#: path MUST be registered here to get that protection.
+DETERMINISM_SURFACES: tuple = (
+    ("journal-replay", "horovod_tpu/router.py", "load_journal",
+     "journal parse feeding exactly-once accept/terminal state"),
+    ("journal-replay", "horovod_tpu/router.py",
+     "RouterServer.replay_journal",
+     "re-submission of non-terminal journal entries on restart"),
+    ("journal-replay", "horovod_tpu/router.py", "compact_journal",
+     "rewrite of the journal file from replayed state"),
+    ("failover-replay", "horovod_tpu/router.py", "RouterServer._on_done",
+     "terminal results recorded for dedupe/journal on completion"),
+    ("failover-replay", "horovod_tpu/supervisor.py", "clone_engine",
+     "respawned engine must be bit-identical to the dead one"),
+    ("chaos-oracle", "horovod_tpu/chaos.py", "ChaosSchedule.generate",
+     "seeded fault schedule replayed across campaign runs"),
+)
+
 #: Canonical one-line descriptions for every registry metric the codebase
 #: emits by literal name — ``to_prometheus()`` renders these as ``# HELP``
 #: lines, and ``tools/check_counter_names.py`` lints call sites against

@@ -565,6 +565,7 @@ class EagerEngine:
                 continue
             self._submitted[p.name] = p
         try:
+            # hvdlint: disable=HVD008 -- negotiated dispatch IS the flush lock's critical section; serializing it is the lock's purpose (see flush docstring)
             bl = self.controller.tick()
         except Exception as e:
             # A broken control plane strands every outstanding op; fail

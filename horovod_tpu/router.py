@@ -360,6 +360,15 @@ class LocalReplica(ReplicaHandle):
 
     _GUARDED_BY_LOCK = ("_inbox", "_cbs", "_dead", "_view", "_stop")
 
+    # Which thread runs what (linted by hvdlint HVD009): the one pump
+    # daemon owns the engine; everything else — router handler
+    # threads, the poller's probes, supervisor stop — calls in through
+    # the public surface and touches shared state only under _lock.
+    _THREAD_ROLES = {
+        "pump": ["_pump"],
+        "callers": ["submit", "probe", "stop"],
+    }
+
     def __init__(self, engine: Any, name: str = "local",
                  faults: "faults_mod.FaultRegistry | None" = None,
                  on_death: "Callable[[LocalReplica], None] | None" = None):
@@ -909,6 +918,24 @@ class RouterServer:
                         "_routed", "_dead", "_cordoned", "_probe_fails",
                         "_next_rid", "_journal_results",
                         "_journal_inflight", "_journal_waiters")
+
+    # Which thread runs what (linted by hvdlint HVD009).  The poller
+    # entries include the membership mutators because supervisor/
+    # autoscaler call replace/add/retire/cordon from inside poll_now's
+    # tick; "lifecycle" is the owning (main/test) thread, which also
+    # drives membership during setup and drain.
+    _THREAD_ROLES = {
+        "http": ["handle_generate", "route", "result", "request_trace",
+                 "health", "state_dump", "replicas_report",
+                 "memory_report", "cordoned"],
+        "poller": ["_poll_loop", "poll_now", "reap_tickets",
+                   "_shadow_bytes", "replace_replica", "add_replica",
+                   "retire_replica", "cordon_replica",
+                   "uncordon_replica"],
+        "replica-callback": ["_on_done", "_on_replica_death"],
+        "lifecycle": ["start", "stop", "replay_journal",
+                      "add_replica", "retire_replica"],
+    }
 
     class _Server(ThreadingHTTPServer):
         daemon_threads = True

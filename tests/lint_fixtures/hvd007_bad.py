@@ -1,0 +1,42 @@
+"""HVD007 fixture: a deliberate two-lock cycle.
+
+``Apex.forward`` holds ``Apex._lock`` and calls into ``Base.poke``
+(which takes ``Base._lock``); ``Base.reverse`` holds ``Base._lock``
+and calls back into ``Apex.grab`` (which takes ``Apex._lock``).  Two
+threads running ``forward`` and ``reverse`` concurrently deadlock.
+Exactly ONE finding: the {Apex._lock, Base._lock} cycle.  ``Apex.tag``
+under ``Base._lock`` is the adjacent good pattern — it takes no lock,
+so the consistent-order edge stays a plain edge, not a cycle."""
+
+import threading
+
+
+class Apex:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = Base(self)
+
+    def forward(self):
+        with self._lock:
+            self.peer.poke()        # Apex._lock -> Base._lock
+
+    def grab(self):
+        with self._lock:
+            self.tally = 1
+
+    def tag(self):
+        return id(self)
+
+
+class Base:
+    def __init__(self, apex):
+        self._lock = threading.Lock()
+        self.apex = apex            # resolved by unique-method evidence
+
+    def poke(self):
+        with self._lock:
+            self.apex.tag()         # lock-free callee: no reverse edge
+
+    def reverse(self):
+        with self._lock:
+            self.apex.grab()        # Base._lock -> Apex._lock: cycle

@@ -1,0 +1,31 @@
+"""HVD008 fixture: an unbounded ``Event.wait()`` under a lock.
+
+``Waiter.stall`` parks forever inside the critical section — every
+other thread needing ``_lock`` queues behind it.  Exactly ONE finding.
+The adjacent good patterns stay quiet: ``bounded`` passes a timeout,
+``outside`` waits with no lock held, and ``lookup`` calls ``.get`` on
+a plain dict (not a queue)."""
+
+import threading
+
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+        self._table = {}
+
+    def stall(self):
+        with self._lock:
+            self._evt.wait()        # unbounded, under _lock: flagged
+
+    def bounded(self):
+        with self._lock:
+            self._evt.wait(0.1)     # timeout bound: exempt
+
+    def outside(self):
+        self._evt.wait()            # no lock held: exempt
+
+    def lookup(self, key):
+        with self._lock:
+            return self._table.get(key)   # dict.get, not Queue.get

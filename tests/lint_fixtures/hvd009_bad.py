@@ -1,0 +1,41 @@
+"""HVD009 fixture: an attribute mutated from two thread roles with no
+guarding lock.
+
+``counter`` is bumped by the pump thread and reset by the control
+thread, lock-free — the declared-guard convention never saw it because
+nobody added it to ``_GUARDED_BY_LOCK``.  Exactly ONE finding.  The
+adjacent good patterns stay quiet: ``total`` is also touched from both
+roles but always under ``_lock``; ``_inbox`` is declared guarded (that
+is HVD002's jurisdiction); ``_thread`` is construction-time only."""
+
+import threading
+
+
+class Pumped:
+    _GUARDED_BY_LOCK = ("_inbox",)
+
+    _THREAD_ROLES = {
+        "pump": ["_pump"],
+        "control": ["kick", "stop"],
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = []
+        self.counter = 0
+        self.total = 0
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+
+    def _pump(self):
+        self.counter += 1           # pump role, no lock: flagged
+        with self._lock:
+            self.total += 1
+
+    def kick(self):
+        self.counter = 0            # control role, no lock: same attr
+        with self._lock:
+            self.total = 0
+
+    def stop(self):
+        with self._lock:
+            self._inbox.append(None)

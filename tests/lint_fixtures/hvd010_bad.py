@@ -1,0 +1,26 @@
+"""HVD010 fixture: a wall-clock read on a declared replay path.
+
+``replay_entries`` is registered as a determinism surface by the test;
+folding ``time.time()`` into its output makes two replays of the same
+journal differ.  Exactly ONE finding.  The adjacent good patterns stay
+quiet: ``replay_clean`` takes the stamp as an input, ``stamp_now`` is
+NOT a declared surface, and ``ordered`` sorts before iterating its
+set."""
+
+import time
+
+
+def replay_entries(entries):
+    out = []
+    for e in entries:
+        out.append((e, time.time()))    # wall clock on a replay path
+    return out
+
+
+def replay_clean(entries, stamp):
+    seen = {e for e in entries}
+    return [(e, stamp) for e in sorted(seen)]
+
+
+def stamp_now():
+    return time.time()                  # not a declared surface

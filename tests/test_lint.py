@@ -2,7 +2,7 @@
 
 Two layers of pinning:
 
-1. *Checker behavior*: each code (HVD001–HVD005) fires exactly once on
+1. *Checker behavior*: each code (HVD001–HVD010) fires exactly once on
    its known-bad fixture (tests/lint_fixtures/) built into a tiny
    synthetic project — and NOT on the adjacent good patterns in the
    same fixture (static shape branches, `_locked` helpers, lock-held
@@ -49,6 +49,21 @@ from tools.hvdlint.checkers.hvd005_names import (  # noqa: E402
 )
 from tools.hvdlint.checkers.hvd006_alert_rules import (  # noqa: E402
     AlertRuleChecker,
+)
+from tools.hvdlint.checkers.hvd007_lock_order import (  # noqa: E402
+    LockOrderChecker,
+    build_lock_graph,
+    find_cycles,
+    lock_order_payload,
+)
+from tools.hvdlint.checkers.hvd008_blocking import (  # noqa: E402
+    BlockingUnderLockChecker,
+)
+from tools.hvdlint.checkers.hvd009_thread_roles import (  # noqa: E402
+    ThreadOwnershipChecker,
+)
+from tools.hvdlint.checkers.hvd010_determinism import (  # noqa: E402
+    ReplayDeterminismChecker,
 )
 
 FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
@@ -253,6 +268,135 @@ def test_hvd006_fires_per_defect(tmp_path):
     ], [f.render() for f in res.active]
 
 
+def test_hvd007_two_lock_cycle_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd007_bad.py"])
+    res = lint(proj, LockOrderChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    f = res.active[0]
+    assert f.code == "HVD007"
+    assert f.symbol == "cycle:Apex._lock->Base._lock"
+    # both acquisition chains are spelled out for the reader
+    assert "Apex._lock -> Base._lock" in f.message
+    assert "Base._lock -> Apex._lock" in f.message
+
+
+def test_hvd007_consistent_order_is_clean(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class Outer:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.inner = Inner()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            self.inner.poke()\n\n"
+        "class Inner:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            pass\n")
+    (tmp_path / "tests").mkdir()
+    proj = Project(tmp_path, package_dirs=("pkg",))
+    res = lint(proj, LockOrderChecker)
+    assert res.active == [], [f.render() for f in res.active]
+    # ...but the edge itself is in the graph
+    walker = build_lock_graph(proj)
+    assert ("Outer._lock", "Inner._lock") in walker.edges
+
+
+def test_hvd008_unbounded_wait_under_lock_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd008_bad.py"])
+    res = lint(proj, BlockingUnderLockChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    f = res.active[0]
+    assert f.code == "HVD008"
+    assert f.symbol.startswith("Waiter.stall:")
+    assert "Waiter._lock" in f.message
+
+
+def test_hvd008_timeout_suppression_honored(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading, time\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def nap(self):\n"
+        "        with self._lock:\n"
+        "            # hvdlint: disable=HVD008 -- settle delay is the "
+        "critical section by design\n"
+        "            time.sleep(0.5)\n")
+    (tmp_path / "tests").mkdir()
+    proj = Project(tmp_path, package_dirs=("pkg",))
+    res = lint(proj, BlockingUnderLockChecker)
+    assert res.active == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].code == "HVD008"
+
+
+def test_hvd009_two_role_unguarded_mutation_fires_once(tmp_path):
+    proj = make_project(tmp_path, ["hvd009_bad.py"])
+    res = lint(proj, ThreadOwnershipChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    f = res.active[0]
+    assert f.code == "HVD009"
+    assert f.symbol == "Pumped.counter:multi-role"
+    assert "pump" in f.message and "control" in f.message
+
+
+def test_hvd009_strict_file_requires_declaration(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import threading\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        pass\n")
+    (tmp_path / "tests").mkdir()
+    proj = Project(tmp_path, package_dirs=("pkg",),
+                   hvd009_strict_files=("pkg/mod.py",))
+    res = lint(proj, ThreadOwnershipChecker)
+    assert [f.symbol for f in res.active] == ["C:undeclared-roles"]
+    # outside the strict list the same class is left alone
+    proj2 = Project(tmp_path, package_dirs=("pkg",),
+                    hvd009_strict_files=())
+    assert lint(proj2, ThreadOwnershipChecker).active == []
+
+
+def test_hvd010_wall_clock_on_replay_path_fires_once(tmp_path):
+    proj = make_project(
+        tmp_path, ["hvd010_bad.py"],
+        determinism_surfaces=(
+            ("journal-replay", "pkg/hvd010_bad.py", "replay_entries",
+             "fixture replay surface"),
+            ("journal-replay", "pkg/hvd010_bad.py", "replay_clean",
+             "fixture clean surface"),
+        ))
+    res = lint(proj, ReplayDeterminismChecker)
+    assert len(res.active) == 1, [f.render() for f in res.active]
+    f = res.active[0]
+    assert f.code == "HVD010"
+    assert f.symbol == "replay_entries:time.time"
+
+
+def test_hvd010_stale_surface_row(tmp_path):
+    proj = make_project(
+        tmp_path, ["hvd010_bad.py"],
+        determinism_surfaces=(
+            ("journal-replay", "pkg/hvd010_bad.py", "vanished_fn",
+             "points at nothing"),
+        ))
+    res = lint(proj, ReplayDeterminismChecker)
+    assert [f.symbol for f in res.active] == [
+        "vanished_fn:stale-surface"]
+
+
 # ---------------------------------------------------------------------------
 # Suppressions and the baseline.
 # ---------------------------------------------------------------------------
@@ -373,22 +517,100 @@ def test_unparsable_file_is_hvd000(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_all_six_checkers_registered():
+def test_all_ten_checkers_registered():
     codes = {c.code for c in all_checkers()}
     assert codes == {"HVD001", "HVD002", "HVD003", "HVD004", "HVD005",
-                     "HVD006"}
+                     "HVD006", "HVD007", "HVD008", "HVD009", "HVD010"}
     assert set(CODES) >= codes | {"HVD000"}
 
 
-def test_repo_is_clean_and_baseline_minimal():
-    """The gate: zero active findings on the real tree, zero stale
-    baseline entries (the committed baseline is minimal), and every
-    suppression in the tree is actually used."""
+def test_repo_is_clean_and_baseline_empty():
+    """The gate: zero active findings on the real tree — including the
+    four concurrency codes — zero stale baseline entries, and every
+    suppression in the tree is actually used.  The committed baseline
+    is required to be EMPTY: no grandfathered debt survives."""
     res = run_lint(REPO_ROOT)
     assert res.active == [], "\n".join(f.render() for f in res.active)
     assert res.stale_baseline == [], res.stale_baseline
     assert res.unused_suppressions == [], [
         (s.path, s.line) for s in res.unused_suppressions]
+    assert res.baselined == [], [f.fingerprint for f in res.baselined]
+    data = json.loads(
+        (REPO_ROOT / "tools" / "hvdlint" / "baseline.json").read_text())
+    assert data["findings"] == []
+
+
+def test_repo_lock_graph_acyclic_and_committed_table_fresh():
+    """The lock-acquisition graph over the real tree has no cycles, and
+    the committed ``lock_order.json`` (rendered into docs/lint.md)
+    matches what ``--write-lock-order`` would emit today."""
+    walker = build_lock_graph(Project(REPO_ROOT))
+    assert find_cycles(walker.edges) == []
+    payload = lock_order_payload(walker)
+    assert payload["edges"], "expected a non-trivial lock graph"
+    committed = json.loads(
+        (REPO_ROOT / "tools" / "hvdlint" /
+         "lock_order.json").read_text())
+    assert committed == payload, (
+        "tools/hvdlint/lock_order.json is stale — regenerate with "
+        "`python -m tools.hvdlint --write-lock-order`")
+
+
+def test_cache_hit_and_mtime_invalidation(tmp_path):
+    """The findings cache is used when nothing changed and is fully
+    invalidated by an edit: inject a marker into the cached payload,
+    see it surface on a warm run, then edit a source file and watch
+    both the marker vanish and the new real finding appear."""
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("X = 1\n")
+    (tmp_path / "tests").mkdir()
+
+    res1 = run_lint(tmp_path, cache=True)
+    assert res1.active == []
+    cache_file = tmp_path / ".hvdlint_cache" / "findings.json"
+    assert cache_file.exists()
+
+    # Tamper with the cached findings (manifest untouched): a warm run
+    # must reflect the cache, proving it was actually read.
+    payload = json.loads(cache_file.read_text())
+    payload["result"]["findings_by_path"]["horovod_tpu/mod.py"] = [{
+        "code": "HVD000", "path": "horovod_tpu/mod.py", "line": 1,
+        "message": "cache marker", "symbol": "marker",
+        "status": "active"}]
+    cache_file.write_text(json.dumps(payload))
+    res2 = run_lint(tmp_path, cache=True)
+    assert [f.message for f in res2.active] == ["cache marker"]
+
+    # An edit changes the manifest: the marker is gone and the real
+    # finding from the edited file shows up.
+    shutil.copy(FIXTURES / "hvd002_bad.py", pkg / "mod.py")
+    res3 = run_lint(tmp_path, cache=True)
+    msgs = [f.message for f in res3.active]
+    assert "cache marker" not in msgs
+    assert [f.symbol for f in res3.active] == ["Window.record._items"]
+    # ...and the re-run repopulated the cache with the true state.
+    res4 = run_lint(tmp_path, cache=True)
+    assert [f.symbol for f in res4.active] == ["Window.record._items"]
+
+    # --no-cache path: same answer, cache never consulted.
+    res5 = run_lint(tmp_path, cache=False)
+    assert [f.symbol for f in res5.active] == ["Window.record._items"]
+
+
+def test_cli_changed_without_git_falls_back(tmp_path):
+    """`--changed` outside a git checkout degrades to a full run."""
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (tmp_path / "tests").mkdir()
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--root", str(tmp_path),
+         "--changed"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "running on everything" in out.stderr
 
 
 def test_cli_json_schema():
@@ -404,7 +626,7 @@ def test_cli_json_schema():
     assert data["summary"]["active"] == 0
     assert {"code", "path", "line", "message", "fingerprint", "status"} \
         <= set(data["findings"][0]) if data["findings"] else True
-    assert "HVD001" in data["codes"] and "HVD005" in data["codes"]
+    assert "HVD001" in data["codes"] and "HVD010" in data["codes"]
 
 
 def test_cli_list_codes():
@@ -413,5 +635,6 @@ def test_cli_list_codes():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
     assert out.returncode == 0
     for code in ("HVD000", "HVD001", "HVD002", "HVD003", "HVD004",
-                 "HVD005"):
+                 "HVD005", "HVD006", "HVD007", "HVD008", "HVD009",
+                 "HVD010"):
         assert code in out.stdout

@@ -1,6 +1,8 @@
 """hvdlint — AST-based invariant linter for the horovod_tpu serving
 stack (retrace hazards, lock discipline, env knobs, fault-site and
-counter-name coverage).
+counter-name coverage, alert-rule hygiene, and the concurrency plane:
+lock-order deadlocks, blocking-under-lock, thread ownership, and
+replay determinism).
 
 Public surface: :func:`run_lint`, :class:`Project`, :class:`Finding`,
 :class:`Checker`, :func:`register`, :data:`CODES`.  See docs/lint.md.

@@ -1,0 +1,129 @@
+"""mtime-keyed result cache for the full-repo lint pass.
+
+The suite runs ``run_lint(REPO_ROOT)`` on every test invocation; with
+ten checkers (four of them interprocedural) that is the slowest lint
+cost in the tier-1 path.  This cache keys the complete run on a
+manifest of every input that can change a finding: the package
+sources, the test files (HVD004 greps them), the docs knob table
+(HVD003), the linter's own code (a checker edit must invalidate), and
+the baseline.  Findings are stored bucketed per source file with the
+file's ``(mtime_ns, size)`` stamp.
+
+Validation is deliberately all-or-nothing: HVD007–HVD010 walk a
+*whole-program* call graph, so a change in one file can create or
+remove findings in another — re-checking only the dirty file would be
+unsound.  Any manifest mismatch therefore discards the cache and
+re-runs everything; a full match reconstructs the
+:class:`~tools.hvdlint.core.LintResult` without even parsing the tree.
+``--no-cache`` (or ``cache=False``, the library default) bypasses it.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any
+
+CACHE_DIR = ".hvdlint_cache"
+CACHE_VERSION = 1
+
+
+def _stat_key(path: pathlib.Path) -> list[int] | None:
+    try:
+        st = path.stat()
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
+
+
+def manifest(project) -> dict[str, list[int] | None]:
+    """``rel path -> (mtime_ns, size)`` over every input that can
+    change a finding."""
+    root = project.root
+    out: dict[str, list[int] | None] = {}
+
+    def add(p: pathlib.Path) -> None:
+        try:
+            rel = p.relative_to(root).as_posix()
+        except ValueError:            # pragma: no cover — defensive
+            rel = str(p)
+        out[rel] = _stat_key(p)
+
+    for sf in project.files:
+        add(sf.abs)
+    for p in project.test_files:
+        add(p)
+    add(root / project.docs_knobs_file)
+    tool_dir = root / "tools" / "hvdlint"
+    if tool_dir.is_dir():
+        for p in sorted(tool_dir.rglob("*.py")):
+            if "__pycache__" not in p.parts:
+                add(p)
+    from tools.hvdlint.core import BASELINE_DEFAULT
+    add(root / BASELINE_DEFAULT)
+    return out
+
+
+def _cache_file(root: pathlib.Path) -> pathlib.Path:
+    return root / CACHE_DIR / "findings.json"
+
+
+def load(project) -> "Any | None":
+    """The cached :class:`LintResult` when every manifest entry still
+    matches, else None."""
+    from tools.hvdlint.core import Finding, LintResult, Suppression
+    path = _cache_file(project.root)
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if data.get("version") != CACHE_VERSION:
+        return None
+    if data.get("manifest") != manifest(project):
+        return None
+    res = data["result"]
+    findings = [Finding(**f)
+                for bucket in res["findings_by_path"].values()
+                for f in bucket]
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return LintResult(
+        root=res["root"],
+        findings=findings,
+        stale_baseline=res["stale_baseline"],
+        unused_suppressions=[
+            Suppression(path=s["path"], line=s["line"],
+                        codes=tuple(s["codes"]),
+                        justification=s.get("justification"))
+            for s in res["unused_suppressions"]],
+        files_scanned=res["files_scanned"])
+
+
+def store(project, result) -> None:
+    """Persist the (unfiltered) run, bucketed per source file.  Cache
+    writes are best-effort: a read-only checkout just runs cold."""
+    by_path: dict[str, list[dict]] = {}
+    for f in result.findings:
+        d = f.to_dict()
+        d.pop("fingerprint", None)
+        d["symbol"] = f.symbol
+        by_path.setdefault(f.path, []).append(d)
+    payload = {
+        "version": CACHE_VERSION,
+        "manifest": manifest(project),
+        "result": {
+            "root": result.root,
+            "files_scanned": result.files_scanned,
+            "findings_by_path": by_path,
+            "stale_baseline": result.stale_baseline,
+            "unused_suppressions": [
+                {"path": s.path, "line": s.line, "codes": list(s.codes),
+                 "justification": s.justification}
+                for s in result.unused_suppressions],
+        },
+    }
+    path = _cache_file(project.root)
+    try:
+        path.parent.mkdir(exist_ok=True)
+        path.write_text(json.dumps(payload) + "\n")
+    except OSError:                   # pragma: no cover — best-effort
+        pass

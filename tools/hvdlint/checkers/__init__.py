@@ -10,4 +10,8 @@ from tools.hvdlint.checkers import (  # noqa: F401
     hvd004_fault_sites,
     hvd005_names,
     hvd006_alert_rules,
+    hvd007_lock_order,
+    hvd008_blocking,
+    hvd009_thread_roles,
+    hvd010_determinism,
 )

@@ -1,0 +1,656 @@
+"""Shared whole-program concurrency model for HVD007–HVD010.
+
+The first six checkers were per-class or per-table; the concurrency
+plane needs a *project-wide* view: which classes own locks, which
+attributes alias which classes (so ``self.router._lock`` resolves to
+``RouterServer._lock``), and what each method calls while holding a
+lock.  This module builds that model once per check from the parsed
+ASTs — stdlib :mod:`ast` only, never importing the package — and
+provides the interprocedural walker HVD007 (lock order) and HVD008
+(blocking under lock) share.
+
+Conventions read here (documented in docs/lint.md):
+
+* lock ownership: ``self.X = threading.Lock()/RLock()`` or the
+  ``*_lock`` alias-naming convention (HVD002's rules, verbatim);
+* ``_LOCK_HOLDER_METHODS`` / ``*_locked`` naming: the method runs with
+  the named (or the class's only) lock already held by its caller;
+* ``_THREAD_ROLES``: a pure-literal class attribute mapping a thread
+  role to its entry-point methods (HVD009);
+* alias resolution, one level deep: ``self.X`` resolves to a project
+  class via (a) ``self.X = ClassName(...)``, (b) the ``__init__``
+  parameter annotation of the value assigned to it, or (c) unique
+  method evidence — every ``self.X.m(...)`` call whose method name is
+  defined by exactly one project class, when all such calls agree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+LOCK_CTORS = {"Lock", "RLock"}
+QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+#: Container-mutating method names (HVD002's list): calling one of
+#: these on an attribute counts as a mutation for HVD009.
+MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "update", "add", "setdefault", "appendleft",
+    "sort", "reverse", "write", "flush", "close",
+}
+
+#: Method names shared with builtin containers/files/locks/futures.
+#: Seeing ``self.X.flush()`` is NOT evidence that ``X`` holds a project
+#: class (it is usually a file), so these never feed unique-method
+#: alias resolution, and calls to them are only followed when the alias
+#: was resolved by the *strong* sources (ctor / annotation).
+BUILTIN_METHODS = MUTATORS | {
+    "get", "keys", "values", "items", "copy", "count", "index",
+    "split", "strip", "startswith", "endswith", "format", "read",
+    "readline", "readlines", "seek", "tell", "encode", "decode",
+    "lower", "upper", "acquire", "release", "locked", "wait", "set",
+    "is_set", "start", "join", "cancel", "result", "done", "put",
+    "qsize", "empty", "full",
+}
+
+_DISPATCH_RE = re.compile(r"all_?reduce|all_?gather|psum|pmean")
+_IO_ATTRS = {"urlopen", "urlretrieve", "getresponse", "create_connection",
+             "connect", "accept", "recv", "recvfrom", "sendall"}
+
+MAX_DEPTH = 12
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> ``"X"``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """Dotted chain for ``a.b.c`` -> ``["a", "b", "c"]``; None when the
+    expression is not a pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except ValueError:
+        return None
+
+
+def _is_ctor(node: ast.AST, names: set[str],
+             module: str | None = None) -> str | None:
+    """``threading.Lock()`` / bare ``Lock()`` style ctor call; returns
+    the ctor name or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in names:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in names and \
+            isinstance(f.value, ast.Name) and \
+            (module is None or f.value.id == module):
+        return f.attr
+    return None
+
+
+def iter_exec_calls(expr: ast.expr) -> Iterator[ast.Call]:
+    """Every Call evaluated when ``expr`` is — skipping Lambda bodies,
+    which run later (often on another thread entirely)."""
+    stack: list[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def expr_roots(stmt: ast.stmt) -> list[ast.expr]:
+    """The expressions evaluated by this statement itself (not the
+    bodies of nested compound statements)."""
+    roots: list[ast.expr] = []
+    for field in ("value", "test", "iter", "exc", "msg"):
+        v = getattr(stmt, field, None)
+        if isinstance(v, ast.expr):
+            roots.append(v)
+    for v in getattr(stmt, "targets", []) or []:
+        if isinstance(v, ast.expr):
+            roots.append(v)
+    tgt = getattr(stmt, "target", None)
+    if isinstance(tgt, ast.expr):
+        roots.append(tgt)
+    if isinstance(stmt, ast.With):
+        for w in stmt.items:
+            roots.append(w.context_expr)
+    if isinstance(stmt, ast.Return) and stmt.value is not None:
+        pass  # covered by "value"
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# Per-class / per-module models.
+# ---------------------------------------------------------------------------
+
+
+class ClassModel:
+    """Everything the concurrency checkers need to know about one
+    class: its locks, declarations, methods, thread targets, and the
+    evidence that resolves ``self.X`` aliases to other classes."""
+
+    def __init__(self, rel: str, node: ast.ClassDef):
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        self.locks: dict[str, str] = {}        # lock attr -> ctor kind
+        self.guarded: dict[str, str] = {}      # attr -> lock attr
+        self.holder_methods: dict[str, set[str]] = {}
+        self.thread_roles: dict[str, tuple[str, ...]] | None = None
+        self.thread_roles_line = node.lineno
+        self.methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        self.queue_attrs: set[str] = set()
+        self.event_attrs: set[str] = set()
+        self.thread_targets: set[str] = set()  # Thread(target=self.<m>)
+        self.attr_ctor: dict[str, str] = {}    # self.X = ClassName(...)
+        self.attr_param: dict[str, str] = {}   # self.X = <init param>
+        self.param_ann: dict[str, str] = {}    # init param -> ann source
+        self.alias_calls: dict[str, set[str]] = {}  # self.X.m() evidence
+        self._scan()
+
+    def _scan(self) -> None:
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+            elif isinstance(item, ast.Assign) and \
+                    len(item.targets) == 1 and \
+                    isinstance(item.targets[0], ast.Name):
+                name = item.targets[0].id
+                if name == "_GUARDED_BY_LOCK":
+                    val = _literal(item.value)
+                    if isinstance(val, dict):
+                        for lock, attrs in val.items():
+                            for a in attrs:
+                                self.guarded[a] = lock
+                    elif isinstance(val, (tuple, list)):
+                        for a in val:
+                            self.guarded[a] = "_lock"
+                elif name == "_LOCK_HOLDER_METHODS":
+                    val = _literal(item.value)
+                    if isinstance(val, dict):
+                        self.holder_methods = {
+                            k: set(v) for k, v in val.items()}
+                elif name == "_THREAD_ROLES":
+                    val = _literal(item.value)
+                    self.thread_roles_line = item.lineno
+                    if isinstance(val, dict):
+                        self.thread_roles = {
+                            str(k): tuple(v) for k, v in val.items()}
+                    else:
+                        self.thread_roles = {}   # malformed: flagged
+        init = self.methods.get("__init__")
+        if init is not None:
+            args = init.args
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                if a.annotation is not None:
+                    try:
+                        self.param_ann[a.arg] = ast.unparse(a.annotation)
+                    except Exception:       # pragma: no cover
+                        pass
+        for sub in ast.walk(self.node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                value = sub.value
+                if value is None:
+                    continue
+                # `self.x = given if given is not None else Default()`
+                # carries evidence in both branches
+                values = ([value.body, value.orelse]
+                          if isinstance(value, ast.IfExp) else [value])
+                for tgt in targets:
+                    attr = self_attr(tgt)
+                    if attr is None:
+                        continue
+                    for value in values:
+                        self._attr_value(attr, value)
+            elif isinstance(sub, ast.Call):
+                if _is_ctor(sub, {"Thread"}, "threading"):
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            t = self_attr(kw.value)
+                            if t is not None:
+                                self.thread_targets.add(t)
+                f = sub.func
+                if isinstance(f, ast.Attribute):
+                    ch = attr_chain(f.value)
+                    if ch is not None and len(ch) == 2 and \
+                            ch[0] == "self":
+                        self.alias_calls.setdefault(
+                            ch[1], set()).add(f.attr)
+
+    def _attr_value(self, attr: str, value: ast.expr) -> None:
+        """Classify one ``self.<attr> = <value>`` assignment."""
+        if _is_ctor(value, LOCK_CTORS, "threading"):
+            self.locks[attr] = _is_ctor(
+                value, LOCK_CTORS, "threading") or "Lock"
+        elif (attr == "_lock" or attr.endswith("_lock")) \
+                and isinstance(value, (ast.Name, ast.Attribute)):
+            # handed-in lock (HVD002's aliasing rule); the real owner
+            # is unknown, so treat as reentrant-unknown for self-loop
+            # purposes.
+            self.locks[attr] = "alias"
+        elif _is_ctor(value, QUEUE_CTORS, "queue"):
+            self.queue_attrs.add(attr)
+        elif _is_ctor(value, {"Event"}, "threading"):
+            self.event_attrs.add(attr)
+        elif isinstance(value, ast.Call):
+            cname = None
+            if isinstance(value.func, ast.Name):
+                cname = value.func.id
+            elif isinstance(value.func, ast.Attribute):
+                cname = value.func.attr
+            if cname and cname[:1].isupper():
+                self.attr_ctor.setdefault(attr, cname)
+        elif isinstance(value, ast.Name):
+            self.attr_param.setdefault(attr, value.id)
+
+    def entry_held(self, mname: str) -> tuple[str, ...]:
+        """Lock attrs this method holds at entry, per declaration:
+        ``_LOCK_HOLDER_METHODS`` membership, or the ``*_locked`` naming
+        convention when the class has exactly one lock."""
+        held: list[str] = []
+        for lock, methods in sorted(self.holder_methods.items()):
+            if mname in methods and lock in self.locks and \
+                    lock not in held:
+                held.append(lock)
+        if mname.endswith("_locked") and len(self.locks) == 1:
+            only = next(iter(self.locks))
+            if only not in held:
+                held.append(only)
+        return tuple(held)
+
+
+class ModuleModel:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        parts = rel.removesuffix(".py").split("/")
+        # `native/__init__.py` owns `native._build_lock`, not
+        # `__init__._build_lock`
+        self.stem = (parts[-2] if parts[-1] == "__init__"
+                     and len(parts) > 1 else parts[-1])
+        self.classes: list[ClassModel] = []
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.module_locks: dict[str, str] = {}     # NAME -> ctor kind
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(ClassModel(rel, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _is_ctor(node.value, LOCK_CTORS, "threading")
+                if kind:
+                    self.module_locks[node.targets[0].id] = kind
+
+
+class ProjectModel:
+    """The whole-program view: every module's classes and functions,
+    class lookup by (unique) name, and cached alias resolution."""
+
+    def __init__(self, project) -> None:
+        self.modules: list[ModuleModel] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            self.modules.append(ModuleModel(sf.rel, sf.tree))
+        self.class_by_name: dict[str, ClassModel | None] = {}
+        self.method_owners: dict[str, set[str]] = {}
+        for mod in self.modules:
+            for cls in mod.classes:
+                if cls.name in self.class_by_name:
+                    self.class_by_name[cls.name] = None   # ambiguous
+                else:
+                    self.class_by_name[cls.name] = cls
+                for m in cls.methods:
+                    self.method_owners.setdefault(m, set()).add(cls.name)
+        self.module_of: dict[int, ModuleModel] = {
+            id(cls): mod for mod in self.modules for cls in mod.classes}
+        self._alias_cache: dict[tuple[str, str, str], ClassModel | None] \
+            = {}
+
+    def resolve_alias(self, cls: ClassModel, attr: str,
+                      with_strength: bool = False):
+        """One level of attribute aliasing: which project class does
+        ``self.<attr>`` hold an instance of?  With ``with_strength``,
+        returns ``(target, strong)`` where ``strong`` means the
+        resolution came from a ctor/annotation (not just call-shape
+        evidence)."""
+        key = (cls.rel, cls.name, attr)
+        if key not in self._alias_cache:
+            self._alias_cache[key] = self._resolve_alias(cls, attr)
+        target, strong = self._alias_cache[key]
+        return (target, strong) if with_strength else target
+
+    def _unique_class(self, name: str) -> ClassModel | None:
+        got = self.class_by_name.get(name)
+        return got if isinstance(got, ClassModel) else None
+
+    def _resolve_alias(self, cls: ClassModel, attr: str) \
+            -> tuple[ClassModel | None, bool]:
+        # (a) direct construction
+        ctor = cls.attr_ctor.get(attr)
+        if ctor:
+            hit = self._unique_class(ctor)
+            if hit is not None:
+                return hit, True
+        # (b) __init__ parameter annotation of the assigned value
+        param = cls.attr_param.get(attr)
+        if param and param in cls.param_ann:
+            for ident in re.findall(r"[A-Za-z_]\w*",
+                                    cls.param_ann[param]):
+                hit = self._unique_class(ident)
+                if hit is not None:
+                    return hit, True
+        # (c) unique-method evidence: every self.<attr>.m() call whose
+        # (non-builtin-shaped) method is defined by exactly one project
+        # class, all agreeing
+        cands: set[str] = set()
+        for m in cls.alias_calls.get(attr, ()):
+            if m in BUILTIN_METHODS:
+                continue
+            owners = self.method_owners.get(m, set())
+            if len(owners) == 1:
+                cands |= owners
+        if len(cands) == 1:
+            return self._unique_class(next(iter(cands))), False
+        return None, False
+
+    def lock_node(self, cls: ClassModel, lock_attr: str) -> str:
+        return f"{cls.name}.{lock_attr}"
+
+    def lock_kind(self, node_name: str) -> str:
+        cls_name, _, attr = node_name.rpartition(".")
+        cls = self._unique_class(cls_name)
+        if cls is not None:
+            return cls.locks.get(attr, "alias")
+        for mod in self.modules:
+            if cls_name == mod.stem and attr in mod.module_locks:
+                return mod.module_locks[attr]
+        return "alias"
+
+
+# ---------------------------------------------------------------------------
+# Blocking-call classification (HVD008).
+# ---------------------------------------------------------------------------
+
+
+def classify_blocking(call: ast.Call, cls: ClassModel | None,
+                      local_queues: set[str]) -> tuple[str, str] | None:
+    """``(kind, description)`` when this call can block indefinitely or
+    dispatch to the device; None when it cannot (or carries a
+    ``timeout=``/``block=`` bound)."""
+    f = call.func
+    attr = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if attr is None:
+        return None
+    ch = attr_chain(f)
+    kwnames = {k.arg for k in call.keywords}
+    bounded = bool(call.args) or "timeout" in kwnames
+
+    try:
+        desc = ast.unparse(f) + "()"
+    except Exception:                      # pragma: no cover
+        desc = attr + "()"
+
+    if attr in ("wait", "join"):
+        return None if bounded else ("wait", desc)
+    if attr in ("get", "put"):
+        recv = f.value if isinstance(f, ast.Attribute) else None
+        is_queue = (
+            (self_attr(recv) in (cls.queue_attrs if cls else ()))
+            or (isinstance(recv, ast.Name) and recv.id in local_queues))
+        if is_queue and "timeout" not in kwnames and \
+                "block" not in kwnames:
+            return ("queue", desc)
+        return None
+    if ch == ["time", "sleep"]:
+        return ("sleep", desc)
+    if attr in _IO_ATTRS or (ch is not None and len(ch) >= 2 and
+                             ch[0] in ("urllib", "socket") or
+                             (ch is not None and ch[:2]
+                              == ["http", "client"])):
+        return ("io", desc)
+    if ch is not None and ch[0] == "subprocess" and \
+            attr in ("run", "call", "check_call", "check_output"):
+        return ("subprocess", desc)
+    if attr == "communicate":
+        return ("subprocess", desc)
+    if attr in ("tick", "spec_tick", "_tick", "_spec_tick") or \
+            _DISPATCH_RE.search(attr):
+        return ("dispatch", desc)
+    return None
+
+
+def local_queue_names(fn: ast.AST) -> set[str]:
+    """Local names bound to a ``queue.Queue(...)``-style ctor inside
+    this function (one level — enough for the repo's idiom)."""
+    out: set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            if sub.value is not None and \
+                    _is_ctor(sub.value, QUEUE_CTORS, "queue"):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The interprocedural walker (HVD007 edges + HVD008 blocking sites).
+# ---------------------------------------------------------------------------
+
+
+class Edge:
+    __slots__ = ("src", "dst", "rel", "line", "chain")
+
+    def __init__(self, src: str, dst: str, rel: str, line: int,
+                 chain: tuple[str, ...]):
+        self.src, self.dst = src, dst
+        self.rel, self.line = rel, line
+        self.chain = chain
+
+    def to_dict(self) -> dict:
+        return {"src": self.src, "dst": self.dst, "path": self.rel,
+                "line": self.line, "via": " -> ".join(self.chain)}
+
+
+class BlockSite:
+    __slots__ = ("rel", "line", "owner", "kind", "desc", "held", "chain")
+
+    def __init__(self, rel: str, line: int, owner: str, kind: str,
+                 desc: str, held: tuple[str, ...],
+                 chain: tuple[str, ...]):
+        self.rel, self.line, self.owner = rel, line, owner
+        self.kind, self.desc = kind, desc
+        self.held, self.chain = held, chain
+
+
+class ConcurrencyWalker:
+    """Walks every method/function, threading the ordered held-lock
+    tuple through ``with`` statements and following calls
+    interprocedurally (``self.m()``, same-module functions, and one
+    level of attribute aliasing).  Nested ``def``\\ s run later —
+    possibly on another thread — and are walked with no held locks,
+    as are Lambda bodies (skipped entirely from call-following)."""
+
+    def __init__(self, pm: ProjectModel):
+        self.pm = pm
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self.blocking: dict[tuple[str, int, str], BlockSite] = {}
+        self._visited: set = set()
+
+    def walk_project(self) -> "ConcurrencyWalker":
+        for mod in self.pm.modules:
+            for cls in mod.classes:
+                for mname in sorted(cls.methods):
+                    if mname in ("__init__", "__new__"):
+                        continue
+                    held = tuple(self.pm.lock_node(cls, a)
+                                 for a in cls.entry_held(mname))
+                    self._walk_fn(mod, cls, cls.methods[mname], held,
+                                  (f"{cls.name}.{mname}",), 0)
+            for fname in sorted(mod.functions):
+                self._walk_fn(mod, None, mod.functions[fname], (),
+                              (fname,), 0)
+        return self
+
+    # -- internals ---------------------------------------------------------
+
+    def _walk_fn(self, mod: ModuleModel, cls: ClassModel | None,
+                 fn: ast.AST, held: tuple[str, ...],
+                 chain: tuple[str, ...], depth: int) -> None:
+        key = (mod.rel, cls.name if cls else "", fn.name, held)
+        if key in self._visited or depth > MAX_DEPTH:
+            return
+        self._visited.add(key)
+        lq = local_queue_names(fn)
+        self._walk_stmts(mod, cls, fn.name, fn.body, held, chain,
+                         depth, lq)
+
+    def _walk_stmts(self, mod, cls, fname, stmts, held, chain, depth,
+                    lq) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                new = list(held)
+                for w in stmt.items:
+                    for call in iter_exec_calls(w.context_expr):
+                        self._call(mod, cls, fname, call, tuple(new),
+                                   chain, depth, lq)
+                    node = self._acquired(mod, cls, w.context_expr)
+                    if node is None:
+                        continue
+                    if node in new:
+                        # immediate re-acquisition: deadlock for a
+                        # plain Lock, legal for RLock/unknown aliases
+                        if self.pm.lock_kind(node) == "Lock":
+                            self._edge(node, node, mod.rel,
+                                       stmt.lineno, chain)
+                        continue
+                    for h in new:
+                        self._edge(h, node, mod.rel, stmt.lineno, chain)
+                    new.append(node)
+                self._walk_stmts(mod, cls, fname, stmt.body, tuple(new),
+                                 chain, depth, lq)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: runs later, possibly on another thread
+                self._walk_stmts(mod, cls, stmt.name, stmt.body, (),
+                                 chain + (f"<nested {stmt.name}>",),
+                                 depth, lq | local_queue_names(stmt))
+                continue
+            for expr in expr_roots(stmt):
+                for call in iter_exec_calls(expr):
+                    self._call(mod, cls, fname, call, held, chain,
+                               depth, lq)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk_stmts(mod, cls, fname, sub, held, chain,
+                                     depth, lq)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk_stmts(mod, cls, fname, handler.body, held,
+                                 chain, depth, lq)
+
+    def _edge(self, src, dst, rel, line, chain) -> None:
+        if (src, dst) not in self.edges:
+            self.edges[(src, dst)] = Edge(src, dst, rel, line, chain)
+
+    def _acquired(self, mod: ModuleModel, cls: ClassModel | None,
+                  expr: ast.expr) -> str | None:
+        """The lock node this with-item acquires, or None."""
+        ch = attr_chain(expr)
+        if ch is None:
+            return None
+        if len(ch) == 1 and ch[0] in mod.module_locks:
+            return f"{mod.stem}.{ch[0]}"
+        if cls is None or ch[0] != "self":
+            return None
+        if len(ch) == 2 and ch[1] in cls.locks:
+            return self.pm.lock_node(cls, ch[1])
+        if len(ch) == 3 and (ch[2] == "_lock"
+                             or ch[2].endswith("_lock")):
+            target = self.pm.resolve_alias(cls, ch[1])
+            if target is not None and ch[2] in target.locks:
+                return self.pm.lock_node(target, ch[2])
+            return f"{cls.name}.{ch[1]}.{ch[2]}"
+        return None
+
+    def _call(self, mod: ModuleModel, cls: ClassModel | None, fname,
+              call: ast.Call, held, chain, depth, lq) -> None:
+        if held:
+            hit = classify_blocking(call, cls, lq)
+            # A dispatch-*named* call that is really a same-class
+            # method (`self._dispatch_allreduce_group(...)`) is a
+            # wrapper: we walk into it, so the true dispatch site
+            # inside is what gets reported, once.
+            if hit is not None and hit[0] == "dispatch" and \
+                    cls is not None and \
+                    isinstance(call.func, ast.Attribute) and \
+                    isinstance(call.func.value, ast.Name) and \
+                    call.func.value.id == "self" and \
+                    call.func.attr in cls.methods:
+                hit = None
+            if hit is not None:
+                kind, desc = hit
+                owner = (f"{cls.name}.{fname}" if cls else fname)
+                key = (mod.rel, call.lineno, desc)
+                if key not in self.blocking:
+                    self.blocking[key] = BlockSite(
+                        mod.rel, call.lineno, owner, kind, desc, held,
+                        chain)
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.functions and f.id != fname:
+                self._walk_fn(mod, None, mod.functions[f.id], held,
+                              chain + (f.id,), depth + 1)
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and cls is not None:
+            m = f.attr
+            if m in cls.methods and m not in ("__init__", "__new__"):
+                self._walk_fn(mod, cls, cls.methods[m], held,
+                              chain + (f"{cls.name}.{m}",), depth + 1)
+            return
+        ch = attr_chain(f.value)
+        if ch is not None and len(ch) == 2 and ch[0] == "self" and \
+                cls is not None:
+            target, strong = self.pm.resolve_alias(
+                cls, ch[1], with_strength=True)
+            if target is not None and f.attr in target.methods and \
+                    f.attr not in ("__init__", "__new__") and \
+                    (strong or f.attr not in BUILTIN_METHODS):
+                tmod = self.pm.module_of.get(id(target))
+                if tmod is not None:
+                    self._walk_fn(
+                        tmod, target, target.methods[f.attr], held,
+                        chain + (f"{target.name}.{f.attr}",), depth + 1)

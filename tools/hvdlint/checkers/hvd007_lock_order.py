@@ -1,0 +1,153 @@
+"""HVD007 — lock-order cycles in the interprocedural acquisition graph.
+
+The lockdep idea, done statically over the repo's declared-lock
+convention: every ``with self.<lock>:`` (or module-lock) acquisition
+that happens while another lock is already held contributes a directed
+edge ``held -> acquired``.  Held state comes from lexical nesting AND
+from the call graph — ``self.m()``, same-module functions, and one
+level of attribute aliasing (``self.router.cordon_replica(...)``
+resolves through :class:`~._concurrency.ProjectModel`), plus the
+``_LOCK_HOLDER_METHODS`` / ``*_locked`` entry declarations.
+
+Any cycle in that graph is a potential deadlock: two threads taking
+the member locks in different orders can each block on the other
+forever.  The finding prints every edge of the cycle with the call
+chain that produced it, so the fix (a global lock order, or releasing
+before calling out) is readable straight from the message.  A plain
+``threading.Lock`` re-acquired while already held is a self-deadlock
+and reported as a one-node cycle (``RLock`` and handed-in aliases are
+exempt — re-entry is legal there).
+
+The full edge list is emitted as ``tools/hvdlint/lock_order.json``
+(``python -m tools.hvdlint --write-lock-order``) and rendered as a
+table in docs/lint.md; the suite asserts the committed file is fresh
+and the repo graph acyclic.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.hvdlint.checkers._concurrency import (
+    ConcurrencyWalker,
+    Edge,
+    ProjectModel,
+)
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+
+def build_lock_graph(project: Project) -> ConcurrencyWalker:
+    """The shared entry point: the walked project (edges + blocking
+    sites) for this checker, HVD008, the CLI emitter, and the tests."""
+    return ConcurrencyWalker(ProjectModel(project)).walk_project()
+
+
+def lock_order_payload(walker: ConcurrencyWalker) -> dict:
+    """The ``lock_order.json`` schema: every acquisition edge, sorted,
+    plus the node set — the raw material for the docs table."""
+    edges = sorted(walker.edges.values(),
+                   key=lambda e: (e.src, e.dst))
+    nodes = sorted({e.src for e in edges} | {e.dst for e in edges})
+    return {"version": 1, "tool": "hvdlint", "locks": nodes,
+            "edges": [e.to_dict() for e in edges]}
+
+
+def find_cycles(edges: dict[tuple[str, str], Edge]) \
+        -> list[list[str]]:
+    """Elementary cycles, one per strongly connected component (plus
+    explicit self-loops).  One finding per SCC keeps the output stable
+    while a multi-edge tangle is being fixed."""
+    adj: dict[str, set[str]] = {}
+    for (src, dst) in edges:
+        adj.setdefault(src, set()).add(dst)
+        adj.setdefault(dst, set())
+
+    # Tarjan's SCC, iteratively (the graph is tiny, but recursion
+    # limits are not a failure mode a linter should have).
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    cycles = [comp for comp in sccs]
+    for (src, dst) in sorted(edges):
+        if src == dst:
+            cycles.append([src])
+    return cycles
+
+
+@register
+class LockOrderChecker(Checker):
+    code = "HVD007"
+    summary = ("lock-order cycle (potential deadlock) in the "
+               "interprocedural lock-acquisition graph")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        walker = build_lock_graph(project)
+        for comp in find_cycles(walker.edges):
+            members = set(comp)
+            cycle_edges = [
+                e for (src, dst), e in sorted(walker.edges.items())
+                if src in members and dst in members
+                and (len(comp) > 1 or src == dst)]
+            if not cycle_edges:        # pragma: no cover — defensive
+                continue
+            chains = "; ".join(
+                f"{e.src} -> {e.dst} at {e.rel}:{e.line} "
+                f"(via {' -> '.join(e.chain)})"
+                for e in cycle_edges)
+            anchor = min(cycle_edges, key=lambda e: (e.rel, e.line))
+            if len(comp) == 1:
+                msg = (f"lock `{comp[0]}` is re-acquired while already "
+                       f"held — a plain threading.Lock self-deadlocks "
+                       f"({chains}); use an RLock or split the method")
+                symbol = f"self-cycle:{comp[0]}"
+            else:
+                msg = (f"lock-order cycle between "
+                       f"{{{', '.join(comp)}}} — threads taking these "
+                       f"locks in different orders can deadlock; "
+                       f"acquisition chains: {chains}")
+                symbol = "cycle:" + "->".join(comp)
+            yield Finding(self.code, anchor.rel, anchor.line, msg,
+                          symbol=symbol)

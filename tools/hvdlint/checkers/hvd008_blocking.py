@@ -1,0 +1,63 @@
+"""HVD008 — calls that can block indefinitely (or dispatch to the
+device) while a declared lock is held.
+
+A lock-order-clean system can still stall fleet-wide if one thread
+parks forever inside a critical section: every other thread needing
+that lock queues behind an ``Event.wait()`` that nobody will set, an
+HTTP probe to a dead replica, or a jit dispatch that takes a
+compilation pause.  This checker reuses HVD007's interprocedural
+walker — the same held-lock state, the same call graph — and flags,
+at any point where at least one lock is held:
+
+* unbounded waits: ``.wait()`` / ``.join()`` with no timeout,
+  ``Queue.get/put`` with neither ``timeout=`` nor ``block=`` (only on
+  receivers known to be queues, so ``dict.get`` stays quiet);
+* network/process I/O: ``urllib``/``socket``/``http.client`` calls,
+  ``subprocess.run``-family, ``.communicate()``;
+* stalls by construction: ``time.sleep``;
+* device dispatch: ``tick``/``spec_tick`` engine steps and
+  allreduce/allgather/psum collective sites — a compile or a slow
+  collective inside a lock serializes the fleet.
+
+``timeout=`` (or a positional bound for ``wait``/``join``) exempts the
+call.  Sites that are provably safe for a reason the checker cannot
+see take a per-site ``# hvdlint: disable=HVD008 -- <why>`` with its
+mandatory justification.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.hvdlint.checkers.hvd007_lock_order import build_lock_graph
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_KIND_HINT = {
+    "wait": "unbounded wait",
+    "queue": "unbounded queue op",
+    "sleep": "sleep",
+    "io": "network I/O",
+    "subprocess": "subprocess wait",
+    "dispatch": "device dispatch",
+}
+
+
+@register
+class BlockingUnderLockChecker(Checker):
+    code = "HVD008"
+    summary = ("call that can block indefinitely or dispatch to the "
+               "device while a lock is held")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        walker = build_lock_graph(project)
+        for site in sorted(walker.blocking.values(),
+                           key=lambda s: (s.rel, s.line, s.desc)):
+            hint = _KIND_HINT.get(site.kind, site.kind)
+            yield Finding(
+                self.code, site.rel, site.line,
+                f"`{site.desc}` ({hint}) runs while holding "
+                f"{{{', '.join(site.held)}}} (reached via "
+                f"{' -> '.join(site.chain)}); bound it with timeout=, "
+                "move it outside the lock, or suppress with a written "
+                "justification",
+                symbol=f"{site.owner}:{site.desc}")

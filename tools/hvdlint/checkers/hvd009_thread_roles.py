@@ -1,0 +1,256 @@
+"""HVD009 — thread-ownership: attributes mutated from two or more
+thread roles without a guarding lock.
+
+``_GUARDED_BY_LOCK`` (HVD002) only protects what someone remembered to
+declare; the blind spot is the attribute nobody declared because
+nobody noticed two threads touch it.  This checker closes that gap
+with a second pure-literal class declaration::
+
+    _THREAD_ROLES = {
+        "pump":   ["_pump"],                 # the replica's own thread
+        "poller": ["poll_now", "_poll_loop"],
+        "http":   ["handle_generate", "result"],
+    }
+
+Each role names its entry-point methods (the ``Thread(target=...)``
+bodies and the public methods a given thread calls into).  The checker
+computes each role's *reachable* method set — the transitive closure
+over ``self.m()`` calls in executed-now position (lambdas and nested
+``def`` bodies are excluded: they run later, usually on a different
+thread) — then collects every ``self.X`` mutation per method with
+HVD002's held-lock tracking.  An attribute mutated from ≥ 2 roles with
+at least one mutation site outside any lock is a data race waiting for
+load, and is reported at the first unguarded site.
+
+Declaration honesty is checked too: role entries must name real
+methods, every ``Thread(target=self.<m>)`` spawn must be assigned to a
+role, and — in the strict file list — a class that spawns threads must
+declare ``_THREAD_ROLES`` at all.  Attributes already covered by
+``_GUARDED_BY_LOCK``, lock objects, and ``threading.Event`` attrs
+(whose ``set``/``clear`` are atomic) are HVD002's jurisdiction and
+skipped here, as is all of ``__init__``/``__new__`` (construction
+happens before the threads exist).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.hvdlint.checkers._concurrency import (
+    MUTATORS,
+    ClassModel,
+    ProjectModel,
+    self_attr,
+)
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+
+class _Mutation:
+    __slots__ = ("attr", "line", "guarded", "what")
+
+    def __init__(self, attr: str, line: int, guarded: bool, what: str):
+        self.attr, self.line = attr, line
+        self.guarded, self.what = guarded, what
+
+
+def _target_attr(tgt: ast.AST) -> str | None:
+    attr = self_attr(tgt)
+    if attr is not None:
+        return attr
+    if isinstance(tgt, ast.Subscript):
+        return self_attr(tgt.value)
+    return None
+
+
+def _collect_mutations(cls: ClassModel, mname: str) -> list[_Mutation]:
+    """Every ``self.X`` mutation in this method, with whether any of
+    the class's locks was held at the site (lexically or by the
+    ``_LOCK_HOLDER_METHODS``/``*_locked`` entry declarations)."""
+    out: list[_Mutation] = []
+    fn = cls.methods[mname]
+    entry_held = bool(cls.entry_held(mname))
+
+    def walk(stmts, held: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                got = held
+                for w in stmt.items:
+                    if self_attr(w.context_expr) in cls.locks:
+                        got = True
+                walk(stmt.body, got)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(stmt.body, False)   # runs later, maybe elsewhere
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for tgt in targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for t in elts:
+                        attr = _target_attr(t)
+                        if attr is not None:
+                            out.append(_Mutation(
+                                attr, stmt.lineno, held, "assigns"))
+            elif isinstance(stmt, ast.Delete):
+                for tgt in stmt.targets:
+                    attr = _target_attr(tgt)
+                    if attr is not None:
+                        out.append(_Mutation(
+                            attr, stmt.lineno, held, "deletes from"))
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.With)):
+                    continue            # handled structurally above
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in MUTATORS:
+                    attr = self_attr(node.func.value)
+                    if attr is not None and \
+                            attr not in cls.event_attrs:
+                        out.append(_Mutation(
+                            attr, node.lineno, held,
+                            f"calls .{node.func.attr}() on"))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    walk(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                walk(handler.body, held)
+
+    walk(fn.body, entry_held)
+    # ast.walk above re-visits nested compound statements' calls; the
+    # held flag there may differ, so dedupe keeping the *guarded*
+    # variant when both were seen for one (attr, line).
+    best: dict[tuple[str, int], _Mutation] = {}
+    for m in out:
+        key = (m.attr, m.line)
+        if key not in best or (m.guarded and not best[key].guarded):
+            best[key] = m
+    return sorted(best.values(), key=lambda m: (m.line, m.attr))
+
+
+def _reachable(cls: ClassModel, entries: tuple[str, ...]) -> set[str]:
+    """Transitive closure over executed-now ``self.m()`` calls."""
+    seen: set[str] = set()
+    work = [m for m in entries if m in cls.methods]
+    while work:
+        m = work.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        fn = cls.methods[m]
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue                  # runs later / other thread
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                callee = self_attr(node.func)
+                if callee is not None and callee in cls.methods and \
+                        callee not in seen:
+                    work.append(callee)
+            stack.extend(ast.iter_child_nodes(node))
+    return seen
+
+
+@register
+class ThreadOwnershipChecker(Checker):
+    code = "HVD009"
+    summary = ("thread ownership: attribute mutated from >=2 declared "
+               "thread roles without a guarding lock, or _THREAD_ROLES "
+               "declaration missing/stale")
+
+    #: Files whose thread-spawning classes MUST declare _THREAD_ROLES.
+    STRICT_FILES = ("horovod_tpu/router.py",)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        strict = (project.hvd009_strict_files
+                  if getattr(project, "hvd009_strict_files", None)
+                  is not None else self.STRICT_FILES)
+        pm = ProjectModel(project)
+        for mod in pm.modules:
+            for cls in mod.classes:
+                yield from self._check_class(
+                    mod.rel, cls, strict_file=mod.rel in strict)
+
+    def _check_class(self, rel: str, cls: ClassModel, *,
+                     strict_file: bool) -> Iterator[Finding]:
+        if cls.thread_roles is None:
+            if strict_file and cls.thread_targets:
+                yield Finding(
+                    self.code, rel, cls.node.lineno,
+                    f"class `{cls.name}` spawns "
+                    f"threading.Thread(target=self.<m>) but declares "
+                    "no _THREAD_ROLES — declare which thread role "
+                    "runs which entry points (see docs/lint.md)",
+                    symbol=f"{cls.name}:undeclared-roles")
+            return
+        if not cls.thread_roles:
+            yield Finding(
+                self.code, rel, cls.thread_roles_line,
+                f"`{cls.name}._THREAD_ROLES` is not a pure-literal "
+                "dict of role -> [entry methods]",
+                symbol=f"{cls.name}:malformed-roles")
+            return
+
+        # Declaration honesty.
+        for role, entries in sorted(cls.thread_roles.items()):
+            for m in entries:
+                if m not in cls.methods:
+                    yield Finding(
+                        self.code, rel, cls.thread_roles_line,
+                        f"`{cls.name}._THREAD_ROLES[{role!r}]` names "
+                        f"`{m}` which is not a method of this class — "
+                        "stale declaration",
+                        symbol=f"{cls.name}.{m}:unknown-role-entry")
+        assigned = {m for entries in cls.thread_roles.values()
+                    for m in entries}
+        for m in sorted(cls.thread_targets):
+            if m not in assigned:
+                yield Finding(
+                    self.code, rel, cls.thread_roles_line,
+                    f"`{cls.name}` spawns Thread(target=self.{m}) but "
+                    f"`{m}` appears in no _THREAD_ROLES entry — every "
+                    "spawned thread needs a role",
+                    symbol=f"{cls.name}.{m}:unassigned-target")
+
+        # Role-reachability x mutations.
+        reach = {role: _reachable(cls, entries)
+                 for role, entries in cls.thread_roles.items()}
+        mutations: dict[str, list[tuple[str, _Mutation]]] = {}
+        for mname in cls.methods:
+            if mname in ("__init__", "__new__"):
+                continue
+            for mut in _collect_mutations(cls, mname):
+                if mut.attr in cls.guarded or mut.attr in cls.locks:
+                    continue             # HVD002's jurisdiction
+                mutations.setdefault(mut.attr, []).append((mname, mut))
+
+        for attr, sites in sorted(mutations.items()):
+            roles_mutating = sorted(
+                role for role, methods in reach.items()
+                if any(mname in methods for mname, _ in sites))
+            unguarded = [(mname, mut) for mname, mut in sites
+                         if not mut.guarded
+                         and any(mname in reach[r]
+                                 for r in roles_mutating)]
+            if len(roles_mutating) >= 2 and unguarded:
+                mname, first = min(unguarded,
+                                   key=lambda s: (s[1].line, s[0]))
+                where = ", ".join(
+                    f"{m}:{mut.line}" for m, mut in sites
+                    if any(m in reach[r] for r in roles_mutating))
+                yield Finding(
+                    self.code, rel, first.line,
+                    f"`self.{attr}` is mutated from thread roles "
+                    f"{{{', '.join(roles_mutating)}}} (sites: {where}) "
+                    f"and `{cls.name}.{mname}` {first.what} it with no "
+                    "lock held — guard it (and declare it in "
+                    "_GUARDED_BY_LOCK) or confine it to one role",
+                    symbol=f"{cls.name}.{attr}:multi-role")

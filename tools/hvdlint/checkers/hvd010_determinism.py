@@ -1,0 +1,193 @@
+"""HVD010 — replay determinism on declared bit-identity surfaces.
+
+The journal replay, failover replay, ``clone_engine``, and the chaos
+oracles all promise the same thing: run the same inputs again and get
+*bit-identical* state.  One ``time.time()`` folded into a persisted
+record, one unseeded ``random`` draw, one iteration over a ``set``
+feeding replayed state, and the promise silently becomes "usually
+close".  Those bugs never fail a unit test — they fail a failover
+three weeks later.
+
+The surfaces are declared in a canonical pure-literal table
+(``horovod_tpu/metrics.py``, next to the other registries)::
+
+    DETERMINISM_SURFACES = (
+        ("journal-replay", "horovod_tpu/router.py", "load_journal",
+         "journal parse -> replayed accept/terminal state"),
+        ...
+    )
+
+For each ``(surface, path, qualname, note)`` row the checker resolves
+the function or ``Class.method``, takes the transitive closure over
+*same-file* calls (``self.m()`` and module functions — cross-class
+aliases are other objects' internals with their own contracts), and
+flags inside that closure:
+
+* wall-clock reads: ``time.time``/``time.time_ns``,
+  ``datetime.now``/``utcnow``/``today``;
+* entropy: ``os.urandom``, module-level ``random.*`` draws and
+  ``random.Random()`` with no seed (``random.Random(seed)`` and
+  ``random.seed(...)`` are the sanctioned idiom and exempt);
+* set-iteration-order dependence: ``for x in {..}`` / ``set(...)`` or
+  a comprehension iterating one (wrap in ``sorted(...)`` instead).
+
+``time.monotonic`` is exempt everywhere — it never persists as an
+absolute value on these surfaces; it measures, it does not stamp.
+A row whose target no longer exists is reported stale, so the table
+tracks the code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.hvdlint.checkers._concurrency import attr_chain, self_attr
+from tools.hvdlint.core import Checker, Finding, Project, register
+
+_WALLCLOCK_TIME = {"time", "time_ns"}
+_WALLCLOCK_DT = {"now", "utcnow", "today"}
+
+
+def _locate(tree: ast.Module, qualname: str) -> ast.AST | None:
+    """Resolve ``func`` or ``Class.method`` to its def node."""
+    cls_name, _, meth = qualname.rpartition(".")
+    for node in tree.body:
+        if not cls_name and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name == qualname:
+            return node
+        if cls_name and isinstance(node, ast.ClassDef) and \
+                node.name == cls_name:
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        item.name == meth:
+                    return item
+    return None
+
+
+def _same_file_closure(tree: ast.Module,
+                       qualname: str) -> list[tuple[str, ast.AST]]:
+    """``[(qualname, def node)]`` reachable from the surface root via
+    same-file calls: module functions by bare name, and ``self.m()``
+    within the root's class."""
+    functions = {n.name: n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
+    cls_name, _, _ = qualname.rpartition(".")
+    methods: dict[str, ast.AST] = {}
+    if cls_name:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                methods = {i.name: i for i in node.body
+                           if isinstance(i, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+    root = _locate(tree, qualname)
+    if root is None:
+        return []
+    out: list[tuple[str, ast.AST]] = []
+    seen: set[str] = set()
+    work: list[tuple[str, ast.AST]] = [(qualname, root)]
+    while work:
+        qn, fn = work.pop()
+        if qn in seen:
+            continue
+        seen.add(qn)
+        out.append((qn, fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in functions:
+                work.append((f.id, functions[f.id]))
+            else:
+                callee = self_attr(f)
+                if callee is not None and callee in methods:
+                    work.append((f"{cls_name}.{callee}",
+                                 methods[callee]))
+    return out
+
+
+def _nondeterminism(fn: ast.AST) -> Iterator[tuple[int, str, str]]:
+    """``(line, kind, desc)`` for every nondeterministic site."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            ch = attr_chain(node.func)
+            if ch is None:
+                continue
+            if ch[0] == "time" and len(ch) == 2 and \
+                    ch[1] in _WALLCLOCK_TIME:
+                yield node.lineno, "wall-clock", ".".join(ch)
+            elif ch[0] == "datetime" and ch[-1] in _WALLCLOCK_DT:
+                yield node.lineno, "wall-clock", ".".join(ch)
+            elif ch == ["os", "urandom"]:
+                yield node.lineno, "entropy", "os.urandom"
+            elif ch[0] == "random" and len(ch) == 2:
+                if ch[1] == "seed":
+                    continue
+                if ch[1] == "Random":
+                    if not node.args and not node.keywords:
+                        yield (node.lineno, "entropy",
+                               "random.Random() [unseeded]")
+                    continue
+                yield node.lineno, "entropy", ".".join(ch)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if _is_set_expr(node.iter):
+                yield node.lineno, "set-order", "for over a set"
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                if _is_set_expr(gen.iter):
+                    yield (node.lineno, "set-order",
+                           "comprehension over a set")
+
+
+def _is_set_expr(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Set):
+        return True
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id in ("set", "frozenset"))
+
+
+@register
+class ReplayDeterminismChecker(Checker):
+    code = "HVD010"
+    summary = ("nondeterminism (wall clock, entropy, set order) on a "
+               "declared bit-identity replay surface")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        by_rel = {sf.rel: sf for sf in project.files}
+        for i, row in enumerate(project.determinism_surfaces):
+            if not (isinstance(row, (tuple, list)) and len(row) == 4
+                    and all(isinstance(x, str) for x in row)):
+                yield Finding(
+                    self.code, Project.METRICS_FILE,
+                    project.line_of(Project.METRICS_FILE,
+                                    "DETERMINISM_SURFACES"),
+                    f"DETERMINISM_SURFACES[{i}] is not a (surface, "
+                    "path, qualname, note) string 4-tuple",
+                    symbol=f"surface[{i}]:malformed")
+                continue
+            surface, rel, qualname, _note = row
+            sf = by_rel.get(rel)
+            tree = sf.tree if sf is not None else None
+            if tree is None or _locate(tree, qualname) is None:
+                yield Finding(
+                    self.code, Project.METRICS_FILE,
+                    project.line_of(Project.METRICS_FILE, qualname),
+                    f"DETERMINISM_SURFACES entry `{qualname}` not "
+                    f"found in {rel} — stale surface row",
+                    symbol=f"{qualname}:stale-surface")
+                continue
+            for qn, fn in _same_file_closure(tree, qualname):
+                for line, kind, desc in sorted(_nondeterminism(fn)):
+                    yield Finding(
+                        self.code, rel, line,
+                        f"`{desc}` ({kind}) inside `{qn}`, reached "
+                        f"from determinism surface `{qualname}` "
+                        f"({surface}) — replayed/persisted state must "
+                        "be bit-identical; take the value from the "
+                        "journal/seed or sort before iterating",
+                        symbol=f"{qn}:{desc}")

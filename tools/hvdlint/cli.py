@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import subprocess
 import sys
 
 
@@ -26,6 +27,20 @@ def _ensure_importable() -> None:
 _ensure_importable()
 
 from tools.hvdlint import core  # noqa: E402
+
+
+def _git_changed(root: pathlib.Path) -> list[str] | None:
+    """Repo-relative paths `git diff --name-only` reports (working tree
+    vs HEAD, plus staged); None when git/the checkout is unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -50,6 +65,18 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the finding codes and exit")
     parser.add_argument("--root", default=None,
                         help="repo root (default: auto-detected)")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="report only findings in files `git diff --name-only` "
+             "lists (fast pre-commit loop; falls back to a full run "
+             "when git is unavailable)")
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the mtime-keyed result cache (.hvdlint_cache/)")
+    parser.add_argument(
+        "--write-lock-order", action="store_true",
+        help="write the HVD007 lock-acquisition edge list to "
+             "tools/hvdlint/lock_order.json and exit")
     args = parser.parse_args(argv)
 
     if args.list_codes:
@@ -73,6 +100,19 @@ def main(argv: list[str] | None = None) -> int:
     else:
         baseline = args.baseline
 
+    if args.write_lock_order:
+        from tools.hvdlint.checkers.hvd007_lock_order import (
+            build_lock_graph,
+            lock_order_payload,
+        )
+        payload = lock_order_payload(
+            build_lock_graph(core.Project(root)))
+        out = root / "tools" / "hvdlint" / "lock_order.json"
+        out.write_text(json.dumps(payload, indent=2) + "\n")
+        print(f"wrote {len(payload['edges'])} edges over "
+              f"{len(payload['locks'])} locks to {out}")
+        return 0
+
     if args.write_baseline:
         result = core.run_lint(root, baseline=None)
         bpath = (root / core.BASELINE_DEFAULT if baseline in ("auto", None)
@@ -82,8 +122,21 @@ def main(argv: list[str] | None = None) -> int:
               "(edit each TODO justification before committing)")
         return 0
 
+    paths = list(args.paths)
+    if args.changed:
+        changed = _git_changed(root)
+        if changed is None:
+            print("hvdlint: --changed: not a git checkout (or git "
+                  "missing); running on everything", file=sys.stderr)
+        elif not changed:
+            print("hvdlint: --changed: no modified files; 0 findings")
+            return 0
+        else:
+            paths.extend(changed)
+
     result = core.run_lint(root, baseline=baseline,
-                           paths=args.paths or None)
+                           paths=paths or None,
+                           cache=not args.no_cache)
 
     if args.as_json:
         json.dump(result.to_dict(), sys.stdout, indent=2)

@@ -213,8 +213,10 @@ class Project:
                  timeline_counter_series: dict | None = None,
                  lifecycle_event_counters: dict | None = None,
                  alert_rules: tuple | None = None,
+                 determinism_surfaces: tuple | None = None,
                  hvd001_targets: tuple[str, ...] | None = None,
-                 hvd002_strict_files: tuple[str, ...] | None = None):
+                 hvd002_strict_files: tuple[str, ...] | None = None,
+                 hvd009_strict_files: tuple[str, ...] | None = None):
         self.root = pathlib.Path(root).resolve()
         self.package_dirs = package_dirs
         self.docs_knobs_file = docs_knobs_file
@@ -238,8 +240,10 @@ class Project:
         self._timeline_counter_series = timeline_counter_series
         self._lifecycle_event_counters = lifecycle_event_counters
         self._alert_rules = alert_rules
+        self._determinism_surfaces = determinism_surfaces
         self.hvd001_targets = hvd001_targets
         self.hvd002_strict_files = hvd002_strict_files
+        self.hvd009_strict_files = hvd009_strict_files
 
     # -- canonical tables (AST-extracted, never imported) ------------------
 
@@ -282,6 +286,14 @@ class Project:
         dicts (pure literal, like every other table)."""
         return self._table(self._alert_rules, self.ALERTS_FILE,
                            "ALERT_RULES", ())
+
+    @property
+    def determinism_surfaces(self) -> tuple:
+        """``horovod_tpu.metrics.DETERMINISM_SURFACES``: the declared
+        bit-identity replay surfaces — (surface, path, qualname, note)
+        rows HVD010 walks for nondeterminism."""
+        return self._table(self._determinism_surfaces, self.METRICS_FILE,
+                           "DETERMINISM_SURFACES", ())
 
     # -- anchors -----------------------------------------------------------
 
@@ -430,20 +442,44 @@ def _dedupe_fingerprints(findings: list[Finding]) -> None:
             f.symbol = f"{f.symbol}#{n + 1}"
 
 
+def _filter_paths(result: LintResult,
+                  paths: Iterable[str] | None) -> LintResult:
+    if not paths:
+        return result
+    prefixes = tuple(str(p) for p in paths)
+    return dataclasses.replace(result, findings=[
+        f for f in result.findings if f.path.startswith(prefixes)])
+
+
 def run_lint(root: str | pathlib.Path | None = None, *,
              project: Project | None = None,
              baseline: str | pathlib.Path | None = "auto",
              checkers: Iterable[type[Checker]] | None = None,
-             paths: Iterable[str] | None = None) -> LintResult:
+             paths: Iterable[str] | None = None,
+             cache: bool = False) -> LintResult:
     """Run the suite and resolve suppressions + baseline.
 
     ``baseline="auto"`` uses the committed ``tools/hvdlint/baseline.json``
     when present; ``None`` disables baselining.  ``paths`` (repo-relative
     prefixes) restricts which files' findings are reported — table-level
     findings anchor to the table file and follow its filtering.
+
+    ``cache=True`` consults the mtime-keyed result cache under
+    ``.hvdlint_cache/`` (see :mod:`tools.hvdlint.cache`) — only for
+    plain full runs (default project, full suite, auto baseline), so
+    synthetic fixture projects and checker subsets never alias a
+    cached repo run.  ``paths`` filtering applies after the cache, to
+    the same unfiltered result a cold run would produce.
     """
+    cacheable = (cache and project is None and checkers is None
+                 and baseline == "auto")
     if project is None:
         project = Project(find_repo_root() if root is None else root)
+    if cacheable:
+        from tools.hvdlint import cache as cache_mod
+        hit = cache_mod.load(project)
+        if hit is not None:
+            return _filter_paths(hit, paths)
     suite = list(checkers) if checkers is not None else all_checkers()
 
     findings: list[Finding] = []
@@ -505,12 +541,12 @@ def run_lint(root: str | pathlib.Path | None = None, *,
             stale = [e for fp, e in sorted(entries.items())
                      if fp not in matched]
 
-    if paths:
-        prefixes = tuple(str(p) for p in paths)
-        findings = [f for f in findings if f.path.startswith(prefixes)]
-
     findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
-    return LintResult(
+    result = LintResult(
         root=str(project.root), findings=findings, stale_baseline=stale,
         unused_suppressions=[s for s in suppressions if not s.used],
         files_scanned=len(project.files))
+    if cacheable:
+        from tools.hvdlint import cache as cache_mod
+        cache_mod.store(project, result)
+    return _filter_paths(result, paths)
