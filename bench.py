@@ -1023,6 +1023,38 @@ def _bench_serve_autoscale(hvd, on_tpu: bool) -> dict:
     }
 
 
+def _bench_serve_simfleet(hvd, on_tpu: bool) -> dict:
+    """Fleet-scale control-plane arm (extras, host-only — no
+    accelerator involved, so it runs on every platform): one seeded
+    :func:`horovod_tpu.simfleet.run_sim_campaign` at bench scale —
+    simulated replicas under a crash storm / partition wave /
+    straggler epidemic / KV-exhaustion ramp, driven through the REAL
+    router + supervisor + autoscaler + alert plane on virtual time.
+    ``serve_simfleet_oracles_ok`` (exactly-once keyed delivery, zero
+    leaked tickets, every fired alert resolved, no autoscaler flap,
+    bounded shadow/journal memory) is the acceptance bar;
+    ``serve_simfleet_wall_s`` watches control-plane cost creep at
+    fleet scale.  The tier-1 suite runs the full 200×100k shape; the
+    bench arm runs a smaller default so it fits the extras ledger
+    (override with HVD_TPU_SIM_REPLICAS / HVD_TPU_SIM_REQUESTS)."""
+    from horovod_tpu.monitor import env_float
+    from horovod_tpu.simfleet import measure_simfleet
+
+    r = measure_simfleet(
+        n_replicas=int(env_float("HVD_TPU_SIM_REPLICAS", 100)),
+        n_requests=int(env_float("HVD_TPU_SIM_REQUESTS", 20000)))
+    out = dict(r)
+    for k in ("serve_simfleet_virtual_s", "serve_simfleet_wall_s",
+              "serve_simfleet_virtual_rps",
+              "serve_simfleet_ok_fraction"):
+        out[k] = round(out[k], 3)
+    out["serve_simfleet_shape"] = (
+        f"r{r['serve_simfleet_replicas']}_"
+        f"n{r['serve_simfleet_requests']}_"
+        f"seed{r['serve_simfleet_seed']}")
+    return out
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1529,7 +1561,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
                _bench_serving_overcommit, _bench_serve_prefix,
                _bench_serve_spec, _bench_serve_tp, _bench_serve_router,
                _bench_serve_chaos, _bench_serve_load,
-               _bench_serve_autoscale,
+               _bench_serve_autoscale, _bench_serve_simfleet,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):
@@ -1831,8 +1863,60 @@ def _lint_preflight() -> None:
         _note(f"lint preflight ok ({summary.get('files_scanned')} files)")
 
 
+def _simfleet_preflight() -> None:
+    """Control-plane regression gate before spending the TPU window:
+    a quick seeded simfleet campaign (host-only, a few seconds), then
+    ``tools/simfleet_run.py --compare`` against the previous round's
+    saved report — a routing/failover/alerting policy regression
+    fails loudly up front, the fifth gate alongside profile_report /
+    load_report / chaos_run / health_report ``--compare``.  Advisory
+    only — a sim regression must not cost a benchmark round; on a
+    clean run the fresh report becomes the next round's baseline."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache = os.environ.get("HVD_TPU_BENCH_CACHE") or here
+    baseline = os.path.join(cache, "simfleet_report.json")
+    fresh = os.path.join(cache, "simfleet_report.new.json")
+    run = [sys.executable, os.path.join(here, "tools", "simfleet_run.py"),
+           "--replicas", "60", "--requests", "8000",
+           "--no-poll-scaling", "--json", fresh]
+    try:
+        out = subprocess.run(run, cwd=here, capture_output=True,
+                             text=True, timeout=180)
+    except Exception as exc:  # noqa: BLE001 — smoke must never raise
+        _note(f"SIMFLEET PREFLIGHT BROKEN: campaign did not run "
+              f"({exc!r})")
+        return
+    if out.returncode != 0 or not os.path.exists(fresh):
+        _note("SIMFLEET PREFLIGHT FAILED: campaign oracles broke — "
+              "run `python tools/simfleet_run.py` locally")
+        return
+    if os.path.exists(baseline):
+        try:
+            cmp_out = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "tools", "simfleet_run.py"),
+                 "--compare", baseline, fresh],
+                cwd=here, capture_output=True, text=True, timeout=60)
+        except Exception as exc:  # noqa: BLE001
+            _note(f"SIMFLEET PREFLIGHT BROKEN: compare did not run "
+                  f"({exc!r})")
+            return
+        if cmp_out.returncode != 0:
+            _note("SIMFLEET PREFLIGHT REGRESSION: "
+                  + "; ".join(l for l in cmp_out.stdout.splitlines()
+                              if l.startswith("REGRESSION")))
+            return
+    try:
+        os.replace(fresh, baseline)
+    except OSError:
+        pass                        # read-only cache: gate still ran
+    _note("simfleet preflight ok (oracles green, no regression)")
+
+
 def _orchestrate() -> None:
     _lint_preflight()
+    _simfleet_preflight()
     hard_limit = float(os.environ.get("HVD_TPU_BENCH_HARD_LIMIT", "840"))
     claim_timeout = float(os.environ.get("HVD_TPU_BENCH_CLAIM_TIMEOUT", "60"))
     attempts = int(os.environ.get("HVD_TPU_BENCH_PROBE_ATTEMPTS", "5"))

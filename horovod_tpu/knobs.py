@@ -133,12 +133,20 @@ ENV_KNOBS = (
      "Port for the RouterServer HTTP front door (maybe_start_router)."),
     ("HVD_TPU_ROUTER_PROBE_FAILS", "3",
      "Consecutive failed probes before an HTTP replica is marked dead."),
+    ("HVD_TPU_ROUTER_SHADOW_MAX_MB", "64",
+     "Fleet-wide shadow prefix index byte ceiling in MB (<= 0 = unbounded)."),
     ("HVD_TPU_ROUTER_TICKET_TTL_S", "600",
      "Seconds a finished router ticket stays readable before reaping."),
     ("HVD_TPU_SAMPLE_S", "1.0",
      "Seconds between time-series samples of the registry (<= 0 = off)."),
     ("HVD_TPU_SCHED_POLICY", "fifo",
      "ServeEngine scheduler policy: fifo, priority, or edf."),
+    ("HVD_TPU_SIM_REPLICAS", "200",
+     "Simulated replica count for the default simfleet campaign."),
+    ("HVD_TPU_SIM_REQUESTS", "100000",
+     "Offered virtual request count for the default simfleet campaign."),
+    ("HVD_TPU_SIM_SEED", "0",
+     "Seed for the simfleet campaign (schedule, chaos, per-replica jitter)."),
     ("HVD_TPU_SLO_E2E_S", "0",
      "End-to-end latency SLO in seconds for goodput (0 = no SLO)."),
     ("HVD_TPU_SPEC", "0",

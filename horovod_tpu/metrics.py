@@ -128,6 +128,10 @@ DETERMINISM_SURFACES: tuple = (
      "respawned engine must be bit-identical to the dead one"),
     ("chaos-oracle", "horovod_tpu/chaos.py", "ChaosSchedule.generate",
      "seeded fault schedule replayed across campaign runs"),
+    ("sim-fleet", "horovod_tpu/simfleet.py", "SimFleet.run",
+     "virtual-time fleet driver replayed bit-identically from seed"),
+    ("sim-campaign", "horovod_tpu/simfleet.py", "run_sim_campaign",
+     "seeded chaos-at-scale campaign diffed by the --compare gate"),
 )
 
 #: Canonical one-line descriptions for every registry metric the codebase
@@ -261,6 +265,9 @@ METRIC_HELP: dict[str, str] = {
     "router.replica_queue_s": "Seconds between router submit and engine enqueue (replica inbox wait)",
     "router.e2e_s": "Seconds from router receive to terminal result, as the client observes",
     "router.failover_hops": "Failover replays one request took before reaching a terminal result",
+    "router.poll_s": "Wall seconds one full poller pass took, probes through ticket reaping",
+    "router.fleet_size": "Replicas currently in the routing candidate set, any health",
+    "router.shadow_evictions": "Shadow-index digests evicted to honor the fleet-wide byte ceiling",
     # supervisor.* — the self-healing layer (horovod_tpu.supervisor)
     "supervisor.respawns": "Dead replicas respawned by the supervisor",
     "supervisor.respawn_failures": "Respawn attempts that failed (fault or factory error)",

@@ -151,6 +151,22 @@ class ShadowPrefixIndex:
         for d in summary.get("paths", ()):
             self._add(d)
 
+    def evict_oldest(self, n: int) -> int:
+        """Drop up to ``n`` oldest digests (the router's fleet-wide
+        byte-ceiling eviction hook — same FIFO order as the
+        ``max_paths`` bound); returns how many were dropped."""
+        dropped = 0
+        while self._order and dropped < n:
+            self._digests.discard(self._order.popleft())
+            dropped += 1
+        if dropped:
+            # Set/deque tables never shrink in place, so the sizeof-based
+            # footprint would floor at the high-water mark and the byte
+            # ceiling could become unreachable; rebuild at current size.
+            self._digests = set(self._digests)
+            self._order = collections.deque(self._order)
+        return dropped
+
     def match_tokens(self, tokens: Sequence[int]) -> int:
         """Tokens of the longest contiguous cached prefix of
         ``tokens`` this shadow knows about (0 without a block size)."""
@@ -753,16 +769,17 @@ class _Ticket:
     The ``*_ts`` / ``*_s`` span fields are the router-side half of
     end-to-end latency attribution (:meth:`RouterServer.request_trace`):
     receive → admission → route decision → journal append → submit,
-    all ``time.monotonic`` so they join the engine
-    :class:`~horovod_tpu.metrics.Trace` stamps exactly (same process,
-    same clock)."""
+    all on the owning router's clock (``time.monotonic`` by default)
+    so they join the engine :class:`~horovod_tpu.metrics.Trace` stamps
+    exactly (same process, same clock)."""
 
     __slots__ = ("rid", "req", "replica", "shed", "failovers",
                  "result", "done", "done_ts", "policy", "key",
                  "journaled", "recv_ts", "submit_ts", "admission_s",
                  "route_decision_s", "journal_s")
 
-    def __init__(self, rid: int, req: Request):
+    def __init__(self, rid: int, req: Request,
+                 now: "float | None" = None):
         self.rid = rid
         self.req = req
         self.replica: str | None = None
@@ -770,11 +787,12 @@ class _Ticket:
         self.failovers = 0
         self.result: RequestResult | None = None
         self.done = threading.Event()
-        self.done_ts = 0.0                  # monotonic, for TTL reaping
+        self.done_ts = 0.0                  # router clock, for TTL reaping
         self.policy = ""
         self.key: str | None = None         # idempotency key, if any
         self.journaled = False              # has an accept WAL record
-        self.recv_ts = time.monotonic()     # front-door arrival
+        self.recv_ts = (time.monotonic()    # front-door arrival
+                        if now is None else now)
         self.submit_ts = 0.0                # first replica submit
         self.admission_s = 0.0              # admission-control check
         self.route_decision_s = 0.0         # policy choose + booking
@@ -929,7 +947,8 @@ class RouterServer:
                  "health", "state_dump", "replicas_report",
                  "memory_report", "cordoned"],
         "poller": ["_poll_loop", "poll_now", "reap_tickets",
-                   "_shadow_bytes", "replace_replica", "add_replica",
+                   "_shadow_bytes", "_enforce_shadow_bound",
+                   "replace_replica", "add_replica",
                    "retire_replica", "cordon_replica",
                    "uncordon_replica"],
         "replica-callback": ["_on_done", "_on_replica_death"],
@@ -954,11 +973,13 @@ class RouterServer:
                  probe_fails: int | None = None,
                  ticket_ttl_s: float | None = None,
                  shadow_max_paths: int = 4096,
+                 shadow_max_bytes: int | None = None,
                  journal: str | None = None,
                  journal_keys: int | None = None,
                  drain_s: float | None = None,
                  sampler: "Any | bool | None" = None,
-                 alerts: "Any | bool | None" = None):
+                 alerts: "Any | bool | None" = None,
+                 clock: Callable[[], float] = time.monotonic):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas: list[ReplicaHandle] = []
@@ -1002,11 +1023,26 @@ class RouterServer:
                         env_float("HVD_TPU_ROUTER_DRAIN_S", 5.0))
         self.faults = (faults if faults is not None
                        else faults_mod.FaultRegistry())
+        #: Every router timestamp — ticket stamps, reap TTLs, drain
+        #: deadlines, e2e spans — reads this clock, so a virtual clock
+        #: (the simfleet driver) advances the whole bookkeeping plane
+        #: without sleeping.  Default is the wall ``time.monotonic``;
+        #: real waits (stop's drain sleep, the poller's cadence) stay
+        #: on wall time regardless.
+        self.clock = clock
 
         self._lock = threading.Lock()
         self._next_rid = 0
         self._tickets: dict[int, _Ticket] = {}
         self.shadow_max_paths = shadow_max_paths
+        # Fleet-wide shadow-index byte ceiling: the per-replica
+        # max_paths bound caps each index, but at hundreds of replicas
+        # the UNION is the leak — past the ceiling the poller evicts
+        # oldest digests from the fattest indexes (<= 0 = unbounded).
+        self.shadow_max_bytes = int(
+            shadow_max_bytes if shadow_max_bytes is not None else
+            env_float("HVD_TPU_ROUTER_SHADOW_MAX_MB", 64.0)
+            * 1024 * 1024)
         self._probe_fails: dict[str, int] = {r.name: 0
                                              for r in self.replicas}
         self._views: dict[str, dict] = {}
@@ -1081,7 +1117,9 @@ class RouterServer:
         self.metrics.counter("router.journal_errors")
         self.metrics.counter("router.journal_replays")
         self.metrics.counter("router.journal_dedups")
+        self.metrics.counter("router.shadow_evictions")
         self.metrics.histogram("router.affinity_hit_tokens")
+        self.metrics.histogram("router.poll_s")
         self.metrics.histogram("router.route_decision_s")
         self.metrics.histogram("router.admission_s")
         self.metrics.histogram("router.journal_append_s")
@@ -1090,6 +1128,7 @@ class RouterServer:
         self.metrics.histogram("router.failover_hops")
         self.metrics.gauge("router.replicas_healthy").set(
             len(self.replicas))
+        self.metrics.gauge("router.fleet_size").set(len(self.replicas))
         self.metrics.gauge("router.inflight").set(0)
         self.metrics.gauge("router.shadow_index_bytes").set(0)
         # Scrape odometer off the shared generation cell (the monitor
@@ -1176,7 +1215,7 @@ class RouterServer:
                     t.journaled = False     # keep the accept unpaired
                     t.result = RequestResult([], FAILED, RuntimeError(
                         "router shut down before completion"))
-                    t.done_ts = time.monotonic()
+                    t.done_ts = self.clock()
                     undrained.append(t)
             # Parked idempotency duplicates have replica=None, so the
             # scan above misses them — and the original they wait on
@@ -1189,7 +1228,7 @@ class RouterServer:
                     if not w.done.is_set():
                         w.result = RequestResult([], FAILED, RuntimeError(
                             "router shut down before completion"))
-                        w.done_ts = time.monotonic()
+                        w.done_ts = self.clock()
                         undrained.append(w)
             self._journal_waiters.clear()
             self._journal_inflight.clear()
@@ -1235,7 +1274,7 @@ class RouterServer:
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            ticket = _Ticket(rid, req)
+            ticket = _Ticket(rid, req, self.clock())
             ticket.key = idempotency_key
             self._tickets[rid] = ticket
             if self._journal is not None and idempotency_key is not None:
@@ -1247,7 +1286,7 @@ class RouterServer:
                     # being retried is the last one to evict.
                     self._journal_results[idempotency_key] = prior
                     ticket.result = prior
-                    ticket.done_ts = time.monotonic()
+                    ticket.done_ts = self.clock()
                     self.metrics.counter("router.journal_dedups").inc()
                 elif idempotency_key in self._journal_inflight:
                     # Original still running: park on its outcome.
@@ -1256,9 +1295,9 @@ class RouterServer:
                     self.metrics.counter("router.journal_dedups").inc()
                     return ticket
             if ticket.result is None:
-                t0 = time.monotonic()
+                t0 = self.clock()
                 shed = self._admission_locked()
-                ticket.admission_s = time.monotonic() - t0
+                ticket.admission_s = self.clock() - t0
                 if shed is not None:
                     self._shed_locked(ticket, shed)
                     return ticket
@@ -1266,20 +1305,20 @@ class RouterServer:
                     ticket.journaled = True
                     if idempotency_key is not None:
                         self._journal_inflight[idempotency_key] = rid
-                t0 = time.monotonic()
+                t0 = self.clock()
                 handle, info = self._place_locked(ticket)
-                ticket.route_decision_s = time.monotonic() - t0
+                ticket.route_decision_s = self.clock() - t0
         if ticket.result is not None:       # journal dedup hit
             ticket.done.set()
             return ticket
         if ticket.journaled:
             # Accept is durable BEFORE the submit: a crash between the
             # append and the callback replays the request on restart.
-            t0 = time.monotonic()
+            t0 = self.clock()
             self._journal_append("router.accept", rid=rid,
                                  key=idempotency_key,
                                  req=request_to_json(req))
-            ticket.journal_s = time.monotonic() - t0
+            ticket.journal_s = self.clock() - t0
             self.metrics.histogram("router.journal_append_s").observe(
                 ticket.journal_s)
         self.metrics.histogram("router.admission_s").observe(
@@ -1290,7 +1329,7 @@ class RouterServer:
                            policy=ticket.policy, **info)
         if self.on_route is not None:
             self.on_route(handle.name, req)
-        ticket.submit_ts = time.monotonic()
+        ticket.submit_ts = self.clock()
         handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
         return ticket
 
@@ -1369,7 +1408,7 @@ class RouterServer:
         users must read a result within the TTL — :meth:`result`
         raises ``KeyError`` for a reaped rid."""
         ttl = self.ticket_ttl_s if older_than_s is None else older_than_s
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             dead = [rid for rid, t in self._tickets.items()
                     if t.done.is_set() and now - t.done_ts >= ttl]
@@ -1441,7 +1480,7 @@ class RouterServer:
         ticket.result = RequestResult([], REJECTED)
         self.metrics.counter("router.sheds").inc()
         self.metrics.event("router.shed", rid=ticket.rid, reason=reason)
-        ticket.done_ts = time.monotonic()
+        ticket.done_ts = self.clock()
         ticket.done.set()
 
     def _place_locked(
@@ -1501,7 +1540,7 @@ class RouterServer:
                     self._inflight[ticket.replica] = max(n - 1, 0)
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
-                ticket.done_ts = time.monotonic()
+                ticket.done_ts = self.clock()
             self.metrics.histogram("router.e2e_s").observe(
                 ticket.done_ts - ticket.recv_ts)
             self.metrics.histogram("router.failover_hops").observe(
@@ -1539,7 +1578,7 @@ class RouterServer:
                 ticket.result = RequestResult([], FAILED, err)
                 self.metrics.gauge("router.inflight").set(
                     sum(self._inflight.values()))
-                ticket.done_ts = time.monotonic()
+                ticket.done_ts = self.clock()
             else:
                 ticket.failovers += 1
                 self.metrics.counter("router.failovers").inc()
@@ -1732,7 +1771,7 @@ class RouterServer:
                 if w.done.is_set():
                     continue
                 w.result = res
-                w.done_ts = time.monotonic()
+                w.done_ts = self.clock()
             w.done.set()
 
     def replay_journal(self) -> int:
@@ -1790,6 +1829,11 @@ class RouterServer:
         shrink the fleet), and a healthy probe brings it back.  A
         local replica's probe is authoritative — its pump thread is
         gone — so it dies on the first unhealthy view and stays dead."""
+        # Pass duration is measured on the wall (perf_counter), never
+        # the injectable clock: under virtual time the pass itself
+        # still costs real host work, and that cost scaling with fleet
+        # size is exactly what router.poll_s exists to expose.
+        pass_t0 = time.perf_counter()
         for r in list(self.replicas):
             try:
                 view = r.probe()
@@ -1811,7 +1855,7 @@ class RouterServer:
             elif not r.can_revive or fails >= self.probe_fails:
                 self._mark_dead(r.name)       # no-op when already dead
         self.metrics.gauge("router.shadow_index_bytes").set(
-            self._shadow_bytes())
+            self._enforce_shadow_bound(self._shadow_bytes()))
         sup = self.supervisor
         if sup is not None:
             sup.tick()
@@ -1825,6 +1869,9 @@ class RouterServer:
         if asc is not None:
             asc.tick()
         self.reap_tickets()
+        self.metrics.gauge("router.fleet_size").set(len(self.replicas))
+        self.metrics.histogram("router.poll_s").observe(
+            time.perf_counter() - pass_t0)
 
     def _poll_loop(self) -> None:
         while not self._poll_stop.wait(self.poll_s):
@@ -1834,6 +1881,33 @@ class RouterServer:
         with self._lock:
             return sum(s.approx_footprint_bytes()
                        for s in self._shadows.values())
+
+    def _enforce_shadow_bound(self, total: int) -> int:
+        """Evict oldest shadow digests until the fleet-wide footprint
+        fits ``shadow_max_bytes``.  The per-index ``max_paths`` FIFO
+        caps each replica, but at hundreds of replicas the *union* is
+        the leak; the poller trims the fattest indexes an eighth at a
+        time so steady-state cost is a handful of deque pops, not a
+        rebuild.  The running total is decremented by each victim's
+        measured shrink rather than re-summed fleet-wide — at 200+
+        replicas a full sizeof scan per eviction round turns the poll
+        pass quadratic.  Returns the (possibly reduced) total."""
+        if self.shadow_max_bytes <= 0:
+            return total
+        evicted = 0
+        while total > self.shadow_max_bytes:
+            with self._lock:
+                victim = max(self._shadows.values(), key=len,
+                             default=None)
+                if victim is None or len(victim) == 0:
+                    break
+                before = victim.approx_footprint_bytes()
+                evicted += victim.evict_oldest(max(len(victim) // 8, 1))
+                total -= before - victim.approx_footprint_bytes()
+        if evicted:
+            self.metrics.counter("router.shadow_evictions").inc(evicted)
+            self.metrics.event("router.shadow_evict", digests=evicted)
+        return total
 
     def health(self) -> tuple[int, dict]:
         """``GET /healthz``: 200 while at least one replica is

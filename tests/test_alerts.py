@@ -662,3 +662,83 @@ def test_health_report_cli_renders_and_gates_regressions(
     assert "REGRESSION" in capsys.readouterr().out
     assert health_mod.main(["--compare", new_json, new_json]) == 0
     assert health_mod.main(["--compare", old_json, old_json]) == 0
+
+
+# ---------------------------------------------------------------------------
+# CapacityAdvisor at fleet scale: demand sizing for hundreds of
+# replicas, and the actuator's step/max clamps on its advice.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("healthy,rate_rps,expect_up", [
+    (50, 120.0, 25),     # ceil(120 / (2 * 0.8)) = 75 needed -> +25
+    (200, 400.0, 50),    # ceil(400 / 1.6) = 250 needed -> +50
+    (500, 960.0, 100),   # ceil(960 / 1.6) = 600 needed -> +100
+])
+def test_advisor_demand_sizing_scales_to_fleet_size(
+        healthy, rate_rps, expect_up):
+    knee = {"serve_load_knee_goodput_rps": 2.0}
+    gauges = {i: {"serve.goodput": 0.9,
+                  "router.replicas_healthy": float(healthy),
+                  "serve.queue_depth": 2.0 * i}      # growing backlog
+              for i in range(1, 7)}
+    counters = {i: {"serve.requests_completed": rate_rps * i}
+                for i in range(1, 7)}
+    _, adv = _advised(gauges, counters, knee=knee)
+    rec = adv.recommend()
+    assert rec["action"] == "scale_up" and rec["n"] == expect_up
+    assert rec["evidence"]["replicas_healthy"] == healthy
+
+
+@pytest.mark.parametrize("healthy,rate_rps,expect_down", [
+    (50, 10.0, 43),      # ceil(10 / 1.6) = 7 needed -> -43
+    (200, 40.0, 175),    # ceil(40 / 1.6) = 25 needed -> -175
+    (500, 100.0, 437),   # ceil(100 / 1.6) = 63 needed -> -437
+])
+def test_advisor_demand_shrink_scales_to_fleet_size(
+        healthy, rate_rps, expect_down):
+    knee = {"serve_load_knee_goodput_rps": 2.0}
+    gauges = {i: {"serve.goodput": 1.0,
+                  "router.replicas_healthy": float(healthy),
+                  "serve.queue_depth": 5.0}          # flat queue
+              for i in range(1, 7)}
+    counters = {i: {"serve.requests_completed": rate_rps * i}
+                for i in range(1, 7)}
+    _, adv = _advised(gauges, counters, knee=knee)
+    rec = adv.recommend()
+    assert rec["action"] == "scale_down" and rec["n"] == expect_down
+
+
+def test_autoscaler_step_cap_then_max_bound_clamp_advice():
+    """A +50 recommendation against a 200-replica SimFleet: the step
+    cap admits 8 per action, and max_replicas truncates even that —
+    the advisor sizes demand, the actuator rations it."""
+    from horovod_tpu.simfleet import SimFleet
+
+    fleet = SimFleet(200, seed=0, max_replicas=204)
+    try:
+        d = fleet.autoscaler.actuate({"action": "scale_up", "n": 50,
+                                      "reason": "demand"})
+        # min(200 + min(50, step=8), max_replicas=204) -> 204.
+        assert d["action"] == "scale_up"
+        assert len(fleet.router.replicas) == 204
+        fleet.clock.advance(3.0)            # past the cooldown guard
+        d2 = fleet.autoscaler.actuate({"action": "scale_up", "n": 50,
+                                       "reason": "demand"})
+        assert d2["action"] == "hold" and "max_replicas" in d2["why"]
+        assert len(fleet.router.replicas) == 204
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_step_cap_alone_rations_big_advice():
+    from horovod_tpu.simfleet import SimFleet
+
+    fleet = SimFleet(50, seed=0, max_replicas=200)
+    try:
+        d = fleet.autoscaler.actuate({"action": "scale_up", "n": 50,
+                                      "reason": "demand"})
+        assert d["action"] == "scale_up"
+        assert len(fleet.router.replicas) == 58     # 50 + step cap 8
+    finally:
+        fleet.close()

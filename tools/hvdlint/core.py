@@ -409,6 +409,7 @@ class LintResult:
 
     def to_dict(self) -> dict:
         """The ``--json`` schema (documented in docs/lint.md)."""
+        all_checkers()  # a cached run skips the registering import
         return {
             "version": 1,
             "root": self.root,
