@@ -525,6 +525,11 @@ def _bench_serving(hvd, on_tpu: bool) -> dict:
         # bound < 2 % like the monitor arm.
         "serve_health_overhead_pct": round(
             r["serve_health_overhead_pct"], 2),
+        # The causal tracing plane priced at 100 % head sampling
+        # (disabled is a None-check per request; the worst case is the
+        # honest number to bound).
+        "serve_trace_overhead_pct": round(
+            r["serve_trace_overhead_pct"], 2),
         "serve_phase_pct": {k: round(v, 1)
                             for k, v in r["serve_phase_pct"].items()},
         "serve_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
@@ -1866,12 +1871,13 @@ def _lint_preflight() -> None:
 def _simfleet_preflight() -> None:
     """Control-plane regression gate before spending the TPU window:
     a quick seeded simfleet campaign (host-only, a few seconds), then
-    ``tools/simfleet_run.py --compare`` against the previous round's
+    ``tools/perf_gate.py --simfleet`` against the previous round's
     saved report — a routing/failover/alerting policy regression
-    fails loudly up front, the fifth gate alongside profile_report /
-    load_report / chaos_run / health_report ``--compare``.  Advisory
-    only — a sim regression must not cost a benchmark round; on a
-    clean run the fresh report becomes the next round's baseline."""
+    fails loudly up front, through the same unified verdict path CI
+    uses for all six gates (profile / load / chaos / health /
+    simfleet / trace).  Advisory only — a sim regression must not
+    cost a benchmark round; on a clean run the fresh report becomes
+    the next round's baseline."""
     import subprocess
     here = os.path.dirname(os.path.abspath(__file__))
     cache = os.environ.get("HVD_TPU_BENCH_CACHE") or here
@@ -1895,8 +1901,8 @@ def _simfleet_preflight() -> None:
         try:
             cmp_out = subprocess.run(
                 [sys.executable,
-                 os.path.join(here, "tools", "simfleet_run.py"),
-                 "--compare", baseline, fresh],
+                 os.path.join(here, "tools", "perf_gate.py"),
+                 "--simfleet", baseline, fresh],
                 cwd=here, capture_output=True, text=True, timeout=60)
         except Exception as exc:  # noqa: BLE001
             _note(f"SIMFLEET PREFLIGHT BROKEN: compare did not run "
@@ -1904,8 +1910,9 @@ def _simfleet_preflight() -> None:
             return
         if cmp_out.returncode != 0:
             _note("SIMFLEET PREFLIGHT REGRESSION: "
-                  + "; ".join(l for l in cmp_out.stdout.splitlines()
-                              if l.startswith("REGRESSION")))
+                  + "; ".join(l.strip()
+                              for l in cmp_out.stdout.splitlines()
+                              if "REGRESSION:" in l))
             return
     try:
         os.replace(fresh, baseline)

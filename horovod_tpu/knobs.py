@@ -159,6 +159,10 @@ ENV_KNOBS = (
      "Respawns per replica before the supervisor circuit-breaks it."),
     ("HVD_TPU_TP", "1",
      "Tensor-parallel degree of ServeEngine (chips per serving replica)."),
+    ("HVD_TPU_TRACE_SAMPLE", "0",
+     "Fraction of requests head-sampled into the causal tracing plane."),
+    ("HVD_TPU_TRACE_SEED", "0",
+     "Seed for the deterministic trace sampler and span-id derivation."),
     ("HVD_TPU_VERIFY_BLOCKS", "0",
      "Walk paged-KV block tables every serve tick (debug, slow)."),
 )

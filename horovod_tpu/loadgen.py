@@ -67,6 +67,7 @@ import threading
 import time
 from typing import Any, Sequence
 
+from horovod_tpu import tracing as tracing_mod
 from horovod_tpu.monitor import env_float
 from horovod_tpu.serving import (OK, REJECTED, TIMEOUT, Request)
 
@@ -390,15 +391,26 @@ def run_open_loop(router: Any, schedule: Sequence[Arrival], *,
         timeout_s = env_float("HVD_TPU_LOAD_TIMEOUT_S", 60.0)
     clock = clock if clock is not None else WallClock()
     clock.start()
-    fired: list[tuple[Arrival, int, float]] = []
-    for a in schedule:
+    frac = tracing_mod.env_sample_fraction()
+    tseed = tracing_mod.env_trace_seed()
+    fired: list[tuple[Arrival, int, float, Any]] = []
+    for idx, a in enumerate(schedule):
         clock.sleep_until(a.t)
+        ctx = None
+        if frac > 0.0:
+            # Client-origin trace root: the sampling key is a pure
+            # function of the (seeded, deterministic) schedule, so the
+            # sampled set replays bit-identically.
+            ctx = tracing_mod.TraceContext.root(
+                f"client:{idx}:{a.t!r}:{a.tenant}", "client",
+                frac, tseed)
+            a.req.trace_ctx = ctx
         send_ts = time.monotonic()
         rid = router.route(a.req)
-        fired.append((a, rid, send_ts))
+        fired.append((a, rid, send_ts, ctx))
     records: list[dict] = []
     deadline = time.monotonic() + timeout_s
-    for a, rid, send_ts in fired:
+    for a, rid, send_ts, ctx in fired:
         remaining = max(deadline - time.monotonic(), 0.001)
         try:
             res = router.result(rid, timeout=remaining)
@@ -406,42 +418,63 @@ def run_open_loop(router: Any, schedule: Sequence[Arrival], *,
         except KeyError:            # reaped mid-collection
             res, trace = None, None
         if res is None:
-            records.append(_record(a, rid, send_ts, None, LOST, 0, None))
+            if ctx is not None:
+                router.tracer.span(ctx, "client", send_ts,
+                                   time.monotonic(), tenant=a.tenant,
+                                   status=LOST)
+            records.append(_record(
+                a, rid, send_ts, None, LOST, 0, None,
+                trace_id=ctx.trace_id if ctx is not None else None))
             continue
         router_done = (trace or {}).get("router", {}).get("done_ts")
-        records.append(_record(a, rid, send_ts,
-                               router_done if router_done else
-                               time.monotonic(),
-                               res.status, len(res), trace))
+        done_ts = router_done if router_done else time.monotonic()
+        if ctx is not None:
+            router.tracer.span(ctx, "client", send_ts, done_ts,
+                               tenant=a.tenant, status=res.status)
+        tid = (ctx.trace_id if ctx is not None else
+               ((trace or {}).get("router") or {}).get("trace_id"))
+        records.append(_record(a, rid, send_ts, done_ts,
+                               res.status, len(res), trace,
+                               trace_id=tid))
     return records
 
 
 def run_open_loop_http(base_url: str, schedule: Sequence[Arrival], *,
                        clock: Any = None,
-                       timeout_s: float | None = None) -> list[dict]:
+                       timeout_s: float | None = None,
+                       tracer: Any = None) -> list[dict]:
     """Drive the HTTP front door open-loop: one daemon thread per
     arrival POSTs ``/v1/generate`` at its scheduled instant, client
     send/receive stamps wrap the wire.  Reply traces (the satellite-1
     ``trace`` dict) give the same attribution join as in-process —
     exact when router and client share a monotonic clock domain (the
-    in-process-server rehearsal), durations-only when truly remote."""
+    in-process-server rehearsal), durations-only when truly remote.
+    Sampled arrivals carry their trace context on the ``traceparent``
+    request header; pass ``tracer`` (e.g. ``router.tracer`` when the
+    server is in-process) to also emit the client span itself."""
     from horovod_tpu.router import request_to_json
     if timeout_s is None:
         timeout_s = env_float("HVD_TPU_LOAD_TIMEOUT_S", 60.0)
     clock = clock if clock is not None else WallClock()
     clock.start()
+    frac = tracing_mod.env_sample_fraction()
+    tseed = tracing_mod.env_trace_seed()
     url = base_url.rstrip("/") + "/v1/generate"
     slots: list = [None] * len(schedule)
+    ctxs: list = [None] * len(schedule)
     threads: list[threading.Thread] = []
 
     def _fire(idx: int, a: Arrival) -> None:
         import urllib.error
         import urllib.request
+        headers = {"Content-Type": "application/json"}
+        if ctxs[idx] is not None:
+            headers["traceparent"] = ctxs[idx].to_header()
         send_ts = time.monotonic()
         try:
             http_req = urllib.request.Request(
                 url, data=json.dumps(request_to_json(a.req)).encode(),
-                headers={"Content-Type": "application/json"})
+                headers=headers)
             try:
                 with urllib.request.urlopen(
                         http_req, timeout=timeout_s) as resp:
@@ -455,6 +488,10 @@ def run_open_loop_http(base_url: str, schedule: Sequence[Arrival], *,
 
     for idx, a in enumerate(schedule):
         clock.sleep_until(a.t)
+        if frac > 0.0:
+            ctxs[idx] = tracing_mod.TraceContext.root(
+                f"client:{idx}:{a.t!r}:{a.tenant}", "client",
+                frac, tseed)
         th = threading.Thread(target=_fire, args=(idx, a), daemon=True,
                               name=f"hvd-loadgen-{idx}")
         th.start()
@@ -465,27 +502,42 @@ def run_open_loop_http(base_url: str, schedule: Sequence[Arrival], *,
     records: list[dict] = []
     for idx, a in enumerate(schedule):
         got = slots[idx]
+        ctx = ctxs[idx]
         if got is None or got[2] is None:
             send_ts = got[0] if got else time.monotonic()
-            records.append(_record(a, -1, send_ts, None, LOST, 0, None))
+            if ctx is not None and tracer is not None:
+                tracer.span(ctx, "client", send_ts, time.monotonic(),
+                            tenant=a.tenant, status=LOST)
+            records.append(_record(
+                a, -1, send_ts, None, LOST, 0, None,
+                trace_id=ctx.trace_id if ctx is not None else None))
             continue
         send_ts, done_ts, body = got
+        if ctx is not None and tracer is not None:
+            tracer.span(ctx, "client", send_ts, done_ts,
+                        tenant=a.tenant,
+                        status=body.get("status", LOST))
+        tid = (ctx.trace_id if ctx is not None else
+               ((body.get("trace") or {}).get("router") or {})
+               .get("trace_id"))
         records.append(_record(a, body.get("rid", -1), send_ts, done_ts,
                                body.get("status", LOST),
                                len(body.get("tokens") or []),
-                               body.get("trace")))
+                               body.get("trace"), trace_id=tid))
     return records
 
 
 def _record(a: Arrival, rid: int, send_ts: float,
             client_done_ts: float | None, status: str, n_tokens: int,
-            trace: dict | None) -> dict:
+            trace: dict | None, *, trace_id: str | None = None) -> dict:
     """One arrival's outcome: client-observed latencies plus the
-    per-phase attribution split (:data:`ATTR_PHASES`)."""
+    per-phase attribution split (:data:`ATTR_PHASES`) and, when the
+    arrival was head-sampled, its causal ``trace_id`` (the join key
+    into ``tools/trace_report.py``)."""
     rec: dict[str, Any] = {
         "rid": rid, "tenant": a.tenant, "poison": a.poison,
         "sched_t": a.t, "status": status, "n_tokens": n_tokens,
-        "slo_s": a.req.slo_s,
+        "slo_s": a.req.slo_s, "trace_id": trace_id,
         "e2e_s": None, "ttft_s": None, "tpot_s": None,
         "good": False, "attr": None,
     }
@@ -587,6 +639,12 @@ def summarize_rung(records: Sequence[dict], *, offered_rps: float,
     tpots = [r["tpot_s"] for r in records if r["tpot_s"] is not None]
     span_s = max(max((r["sched_t"] for r in records), default=0.0)
                  + (max(e2es) if e2es else 0.0), duration_s, 1e-9)
+    # Exemplars: the slowest sampled requests of the rung — trace ids
+    # a reader can feed straight to ``tools/trace_report.py`` to see
+    # WHERE the rung's tail latency lives.
+    tailed = sorted((r for r in records
+                     if r.get("trace_id") and r["e2e_s"] is not None),
+                    key=lambda r: r["e2e_s"], reverse=True)
     return {
         "offered_rps": offered_rps,
         "duration_s": duration_s,
@@ -606,6 +664,7 @@ def summarize_rung(records: Sequence[dict], *, offered_rps: float,
         "goodput_rps": len(good) / span_s,
         "tokens": sum(r["n_tokens"] for r in records),
         "attribution": attribute(records),
+        "exemplar_trace_ids": [r["trace_id"] for r in tailed[:3]],
     }
 
 
@@ -744,6 +803,7 @@ def measure_saturation(
         "serve_load_timeout_rate_top": rungs[-1]["timeout_rate"],
         "ladder": list(ladder),
         "knee_index": knee_i,
+        "knee_exemplar_trace_ids": knee["exemplar_trace_ids"],
         "rungs": rungs,
     }
     if keep_records:

@@ -132,6 +132,8 @@ DETERMINISM_SURFACES: tuple = (
      "virtual-time fleet driver replayed bit-identically from seed"),
     ("sim-campaign", "horovod_tpu/simfleet.py", "run_sim_campaign",
      "seeded chaos-at-scale campaign diffed by the --compare gate"),
+    ("trace-sampling", "horovod_tpu/tracing.py", "sampled",
+     "head-sampling decision is a pure function of (seed, request id)"),
 )
 
 #: Canonical one-line descriptions for every registry metric the codebase
@@ -283,6 +285,9 @@ METRIC_HELP: dict[str, str] = {
     "autoscaler.cordons": "Replicas cordoned out of routing pending drain",
     "autoscaler.draining": "Replicas currently cordoned and draining in-flight work",
     "autoscaler.replicas_target": "Fleet size the last actuation drove toward",
+    # trace.* — the causal span-tree plane (horovod_tpu.tracing)
+    "trace.sampled": "Requests head-sampled into the tracing plane at a root",
+    "trace.spans": "Closed trace.span records emitted to the event log",
 }
 
 
@@ -403,8 +408,9 @@ class Histogram:
     """
 
     __slots__ = ("name", "bounds", "_lock", "_gen", "_counts", "_count",
-                 "_sum", "_min", "_max")
-    _GUARDED_BY_LOCK = ("_counts", "_count", "_sum", "_min", "_max")
+                 "_sum", "_min", "_max", "_exemplars")
+    _GUARDED_BY_LOCK = ("_counts", "_count", "_sum", "_min", "_max",
+                        "_exemplars")
 
     def __init__(self, name: str, lock: threading.Lock,
                  bounds: tuple[float, ...] | None = None,
@@ -420,17 +426,27 @@ class Histogram:
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # bucket index -> (trace_id, value): the OpenMetrics-style
+        # exemplar store, lazily created so untraced histograms pay
+        # nothing.  Last-write-wins per bucket — the p99 bucket always
+        # links to the most recent trace that landed there.
+        self._exemplars: dict[int, tuple[str, float]] | None = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
         with self._lock:
-            self._counts[bisect_left(self.bounds, v)] += 1
+            idx = bisect_left(self.bounds, v)
+            self._counts[idx] += 1
             self._count += 1
             self._sum += v
             if v < self._min:
                 self._min = v
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[idx] = (exemplar, v)
             self._gen.n += 1
 
     @property
@@ -472,7 +488,7 @@ class Histogram:
                     "p50": 0.0, "p90": 0.0, "p99": 0.0,
                     "buckets": list(self._counts),
                     "bounds": list(self.bounds)}
-        return {
+        snap = {
             "count": self._count,
             "sum": self._sum,
             "min": self._min,
@@ -483,6 +499,15 @@ class Histogram:
             "buckets": list(self._counts),
             "bounds": list(self.bounds),
         }
+        if self._exemplars:
+            # keyed by the bucket's le edge label ("+Inf" for overflow)
+            # so readers need no index arithmetic; absent entirely when
+            # no traced observation ever landed (schema-stable default).
+            snap["exemplars"] = {
+                (f"{self.bounds[i]:g}" if i < len(self.bounds)
+                 else "+Inf"): {"trace_id": tid, "value": v}
+                for i, (tid, v) in sorted(self._exemplars.items())}
+        return snap
 
 
 # ---------------------------------------------------------------------------
@@ -788,11 +813,22 @@ class MetricsRegistry:
                 pn = _prom_name(name)
                 _head(name, pn, "histogram")
                 cum = 0
-                for edge, c in zip(h.bounds, h._counts):
+                ex = h._exemplars or {}
+                for i, (edge, c) in enumerate(zip(h.bounds, h._counts)):
                     cum += c
                     le = escape_label_value(f"{edge:g}")
-                    lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
-                lines.append(f'{pn}_bucket{{le="+Inf"}} {h._count}')
+                    line = f'{pn}_bucket{{le="{le}"}} {cum}'
+                    if i in ex:
+                        tid, v = ex[i]
+                        line += (f' # {{trace_id="'
+                                 f'{escape_label_value(tid)}"}} {v:g}')
+                    lines.append(line)
+                line = f'{pn}_bucket{{le="+Inf"}} {h._count}'
+                if len(h.bounds) in ex:
+                    tid, v = ex[len(h.bounds)]
+                    line += (f' # {{trace_id="'
+                             f'{escape_label_value(tid)}"}} {v:g}')
+                lines.append(line)
                 lines.append(f"{pn}_sum {h._sum:g}")
                 lines.append(f"{pn}_count {h._count}")
             text = "\n".join(lines) + "\n"
@@ -840,7 +876,7 @@ class _NullGauge(Gauge):
 class _NullHistogram(Histogram):
     __slots__ = ()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         pass
 
 
@@ -908,6 +944,13 @@ class Trace:
     retries: int = 0
     prefix_tokens_skipped: int = 0
     queue_steps: int = 0
+    # Causal-tracing identity (None on unsampled requests): the trace
+    # this request belongs to, its own serve.request span, and the
+    # propagated parent (a router replica.attempt span, or None on an
+    # engine-origin root).  See horovod_tpu.tracing.
+    trace_id: str | None = None
+    span_id: str | None = None
+    parent_span_id: str | None = None
 
     @property
     def queue_wait_s(self) -> float | None:

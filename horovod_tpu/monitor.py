@@ -175,10 +175,19 @@ class _Handler(BaseHTTPRequestHandler):
                 advisor.recommend()
                 self._reply(200, json.dumps(advisor.report()),
                             "application/json")
+        elif path == "/traces":
+            tracer = getattr(mon.engine, "tracer", None)
+            if tracer is None:
+                self._reply(404, "no tracer attached "
+                                 "(engine off or pre-tracing)\n",
+                            "text/plain")
+            else:
+                self._reply(200, json.dumps(tracer.recent()),
+                            "application/json")
         else:
             self._reply(404, "unknown path; try /metrics /snapshot "
                              "/healthz /state /profile /timeseries "
-                             "/alerts /advice\n",
+                             "/alerts /advice /traces\n",
                         "text/plain")
 
     def log_message(self, fmt: str, *args: Any) -> None:
@@ -222,7 +231,7 @@ class MonitorServer:
 
     _SCRAPE_ENDPOINTS = frozenset(
         {"metrics", "snapshot", "healthz", "state", "profile",
-         "timeseries", "alerts", "advice", "root"})
+         "timeseries", "alerts", "advice", "traces", "root"})
 
     def _scrape_obs(self, endpoint: str) -> tuple[Any, Any]:
         """(latency histogram, error counter) for one endpoint, created

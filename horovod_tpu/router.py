@@ -89,6 +89,7 @@ from typing import Any, Callable, Sequence
 
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
+from horovod_tpu import tracing as tracing_mod
 from horovod_tpu.monitor import env_float
 from horovod_tpu.prefix_cache import chunk_path_digests
 from horovod_tpu.serving import (FAILED, OK, REJECTED, Request,
@@ -634,13 +635,20 @@ def request_to_json(req: Request) -> dict:
     """The ``POST /v1/generate`` wire form of a :class:`Request`
     (greedy serving fields only — the router is greedy-only, like
     :class:`ServeEngine`)."""
-    return {"prompt": list(req.prompt),
-            "max_new_tokens": req.max_new_tokens,
-            "eos_id": req.eos_id,
-            "deadline_s": req.deadline_s,
-            "max_queue_steps": req.max_queue_steps,
-            "slo_s": req.slo_s,
-            "priority": req.priority}
+    out = {"prompt": list(req.prompt),
+           "max_new_tokens": req.max_new_tokens,
+           "eos_id": req.eos_id,
+           "deadline_s": req.deadline_s,
+           "max_queue_steps": req.max_queue_steps,
+           "slo_s": req.slo_s,
+           "priority": req.priority}
+    ctx = getattr(req, "trace_ctx", None)
+    if ctx is not None:
+        # Optional causal-trace context: HttpReplica serializes the
+        # request at submit time, AFTER the router stamped the current
+        # attempt's span — so the remote hop parents under this hop.
+        out["trace"] = ctx.to_dict()
+    return out
 
 
 def _opt_number(payload: dict, field: str) -> "float | None":
@@ -680,7 +688,11 @@ def request_from_json(payload: dict) -> Request:
                    deadline_s=_opt_number(payload, "deadline_s"),
                    max_queue_steps=_opt_int(payload, "max_queue_steps"),
                    slo_s=_opt_number(payload, "slo_s"),
-                   priority=_opt_int(payload, "priority") or 0)
+                   priority=_opt_int(payload, "priority") or 0,
+                   # Malformed trace dicts degrade to None (untraced),
+                   # never 400 — tracing must not fail a request.
+                   trace_ctx=tracing_mod.TraceContext.from_dict(
+                       payload.get("trace")))
 
 
 # ---------------------------------------------------------------------------
@@ -776,7 +788,8 @@ class _Ticket:
     __slots__ = ("rid", "req", "replica", "shed", "failovers",
                  "result", "done", "done_ts", "policy", "key",
                  "journaled", "recv_ts", "submit_ts", "admission_s",
-                 "route_decision_s", "journal_s")
+                 "route_decision_s", "journal_s", "tctx", "tparent",
+                 "attempt_ctx", "attempt_parent", "attempt_t0")
 
     def __init__(self, rid: int, req: Request,
                  now: "float | None" = None):
@@ -797,6 +810,16 @@ class _Ticket:
         self.admission_s = 0.0              # admission-control check
         self.route_decision_s = 0.0         # policy choose + booking
         self.journal_s = 0.0                # accept WAL append
+        # Causal-trace state (None/unsampled on most tickets): the
+        # router.request span context, its propagated parent span id,
+        # and the CURRENT delivery attempt's span — each failover
+        # replay becomes a child of the attempt it replaced, so a
+        # multi-hop request renders as one chain in one tree.
+        self.tctx: "tracing_mod.TraceContext | None" = None
+        self.tparent: str | None = None
+        self.attempt_ctx: "tracing_mod.TraceContext | None" = None
+        self.attempt_parent: str | None = None
+        self.attempt_t0 = 0.0
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -876,11 +899,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self._reply(200,
                                 json.dumps(router.autoscaler.report()),
                                 "application/json")
+            elif path == "/traces":
+                self._reply(200, json.dumps(router.tracer.recent()),
+                            "application/json")
             else:
                 self._reply(404, "unknown path; try /v1/generate "
                                  "/replicas /snapshot /healthz "
                                  "/metrics /state /timeseries "
-                                 "/alerts /advice /autoscaler\n",
+                                 "/alerts /advice /autoscaler "
+                                 "/traces\n",
                             "text/plain")
         except BrokenPipeError:
             pass
@@ -897,6 +924,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n).decode())
                 req = request_from_json(payload)
+                if req.trace_ctx is None:
+                    # W3C traceparent-style header — the JSON "trace"
+                    # field wins when both arrive (same trust domain,
+                    # and HttpReplica hops only send the field).
+                    req.trace_ctx = tracing_mod.TraceContext.from_header(
+                        self.headers.get("traceparent"))
                 key = payload.get("idempotency_key")
                 if key is not None and not isinstance(key, str):
                     raise ValueError(
@@ -951,7 +984,8 @@ class RouterServer:
                    "replace_replica", "add_replica",
                    "retire_replica", "cordon_replica",
                    "uncordon_replica"],
-        "replica-callback": ["_on_done", "_on_replica_death"],
+        "replica-callback": ["_on_done", "_on_replica_death",
+                             "_emit_ticket_spans"],
         "lifecycle": ["start", "stop", "replay_journal",
                       "add_replica", "retire_replica"],
     }
@@ -1030,6 +1064,13 @@ class RouterServer:
         #: real waits (stop's drain sleep, the poller's cadence) stay
         #: on wall time regardless.
         self.clock = clock
+        # Causal tracing plane: spans persist through this registry's
+        # event sink; the sampler decision is pure (seed, rid) — see
+        # horovod_tpu.tracing.  Fraction 0 (the default) costs one
+        # attribute test per request.
+        self.tracer = tracing_mod.Tracer(self.metrics)
+        self._trace_fraction = tracing_mod.env_sample_fraction()
+        self._trace_seed = tracing_mod.env_trace_seed()
 
         self._lock = threading.Lock()
         self._next_rid = 0
@@ -1277,6 +1318,21 @@ class RouterServer:
             ticket = _Ticket(rid, req, self.clock())
             ticket.key = idempotency_key
             self._tickets[rid] = ticket
+            in_ctx = getattr(req, "trace_ctx", None)
+            if in_ctx is not None:
+                # Propagated context (client header/field, or a journal
+                # replay's original span): this hop is its child.
+                ticket.tctx = in_ctx.child("router.request")
+                ticket.tparent = in_ctx.span_id
+            elif self._trace_fraction > 0.0:
+                # Router-origin root, head-sampled on the request id —
+                # pure (seed, rid), so simfleet replays sample
+                # identically.
+                ticket.tctx = tracing_mod.TraceContext.root(
+                    f"router:{rid}", "router.request",
+                    self._trace_fraction, self._trace_seed)
+                if ticket.tctx is not None:
+                    tracing_mod.count_sampled(self.metrics)
             if self._journal is not None and idempotency_key is not None:
                 prior = self._journal_results.pop(idempotency_key, None)
                 if prior is not None:
@@ -1315,9 +1371,15 @@ class RouterServer:
             # Accept is durable BEFORE the submit: a crash between the
             # append and the callback replays the request on restart.
             t0 = self.clock()
-            self._journal_append("router.accept", rid=rid,
-                                 key=idempotency_key,
-                                 req=request_to_json(req))
+            self._journal_append(
+                "router.accept", rid=rid, key=idempotency_key,
+                req=request_to_json(req),
+                # The router.request span context rides the accept
+                # record so a crash-recovery replay rejoins the SAME
+                # trace as a child of this span (one tree across
+                # incarnations).
+                trace=(ticket.tctx.to_dict()
+                       if ticket.tctx is not None else None))
             ticket.journal_s = self.clock() - t0
             self.metrics.histogram("router.journal_append_s").observe(
                 ticket.journal_s)
@@ -1330,6 +1392,14 @@ class RouterServer:
         if self.on_route is not None:
             self.on_route(handle.name, req)
         ticket.submit_ts = self.clock()
+        if ticket.tctx is not None:
+            # First delivery attempt: the engine (or remote hop) will
+            # parent its serve.request span under this attempt, so the
+            # request object carries the attempt context from here on.
+            ticket.attempt_ctx = ticket.tctx.child("replica.attempt")
+            ticket.attempt_parent = ticket.tctx.span_id
+            ticket.attempt_t0 = ticket.submit_ts
+            req.trace_ctx = ticket.attempt_ctx
         handle.submit(req, lambda res, t=ticket: self._on_done(t, res))
         return ticket
 
@@ -1386,6 +1456,13 @@ class RouterServer:
             "failovers": ticket.failovers,
             "replica": ticket.replica,
             "shed": ticket.shed,
+            # Sampled requests carry their trace identity out to the
+            # client (and loadgen's attribution records) so a slow
+            # reply links straight to its reconstructable span tree.
+            "trace_id": (ticket.tctx.trace_id
+                         if ticket.tctx is not None else None),
+            "span_id": (ticket.tctx.span_id
+                        if ticket.tctx is not None else None),
         }
         if ticket.done_ts > 0:
             router["e2e_s"] = ticket.done_ts - ticket.recv_ts
@@ -1397,6 +1474,38 @@ class RouterServer:
             router["finish_s"] = max(ticket.done_ts - term, 0.0)
         base["router"] = router
         return base
+
+    def _emit_ticket_spans(self, ticket: _Ticket, res: Any,
+                           attempt_done: bool = False) -> None:
+        """Post-hoc span emission for a finished sampled ticket — all
+        stamps come from the ticket (the injectable router clock), so
+        virtual-time drivers trace without wall reads.  The front-door
+        sub-spans (admission → route decision → journal append) tile
+        sequentially from the receive stamp; ``attempt_done`` skips the
+        final attempt span when the failover path already closed it."""
+        tctx = ticket.tctx
+        cur = ticket.recv_ts
+        for name, dur in (("router.admission", ticket.admission_s),
+                          ("router.route_decision",
+                           ticket.route_decision_s),
+                          ("router.journal_append", ticket.journal_s)):
+            if dur > 0.0:
+                self.tracer.span(tctx.child(name), name, cur, cur + dur,
+                                 parent_id=tctx.span_id)
+                cur += dur
+        if ticket.attempt_ctx is not None and not attempt_done:
+            self.tracer.span(
+                ticket.attempt_ctx, "replica.attempt",
+                ticket.attempt_t0, ticket.done_ts,
+                parent_id=ticket.attempt_parent, rid=ticket.rid,
+                replica=ticket.replica,
+                status=getattr(res, "status", None))
+        self.tracer.span(
+            tctx, "router.request", ticket.recv_ts, ticket.done_ts,
+            parent_id=ticket.tparent, rid=ticket.rid,
+            replica=ticket.replica, failovers=ticket.failovers,
+            policy=ticket.policy, shed=ticket.shed,
+            status=getattr(res, "status", None))
 
     def reap_tickets(self, older_than_s: float | None = None) -> int:
         """Drop tickets whose terminal result has been readable for at
@@ -1542,7 +1651,11 @@ class RouterServer:
                     sum(self._inflight.values()))
                 ticket.done_ts = self.clock()
             self.metrics.histogram("router.e2e_s").observe(
-                ticket.done_ts - ticket.recv_ts)
+                ticket.done_ts - ticket.recv_ts,
+                # OpenMetrics-style exemplar: the p99 bucket links
+                # straight to a reconstructable trace.
+                exemplar=(ticket.tctx.trace_id
+                          if ticket.tctx is not None else None))
             self.metrics.histogram("router.failover_hops").observe(
                 float(ticket.failovers))
             tr = getattr(res, "trace", None)
@@ -1552,6 +1665,8 @@ class RouterServer:
                 # stamp joins the router submit stamp directly.
                 self.metrics.histogram("router.replica_queue_s").observe(
                     max(tr.enqueue_ts - ticket.submit_ts, 0.0))
+            if ticket.tctx is not None:
+                self._emit_ticket_spans(ticket, res)
             ticket.done.set()
             if ticket.journaled:
                 self._journal_terminal(ticket, res)
@@ -1583,7 +1698,33 @@ class RouterServer:
                 ticket.failovers += 1
                 self.metrics.counter("router.failovers").inc()
                 handle, info = self._place_locked(ticket)
+            failed_attempt = None
+            if ticket.tctx is not None and ticket.attempt_ctx is not None:
+                # Close the failed attempt's span and (on replay) chain
+                # the next attempt as its CHILD — the failover replay
+                # renders under the hop it replaced, one tree.
+                now = self.clock()
+                failed_attempt = (ticket.attempt_ctx,
+                                  ticket.attempt_parent,
+                                  ticket.attempt_t0, now, old)
+                if err is None:
+                    ticket.attempt_parent = ticket.attempt_ctx.span_id
+                    ticket.attempt_ctx = ticket.attempt_ctx.child(
+                        "replica.attempt", seq=ticket.failovers)
+                    ticket.attempt_t0 = now
+                    ticket.req.trace_ctx = ticket.attempt_ctx
+        if failed_attempt is not None:
+            ctx, parent, t0, t1, replica = failed_attempt
+            self.tracer.span(ctx, "replica.attempt", t0, t1,
+                             parent_id=parent, rid=ticket.rid,
+                             replica=replica,
+                             status="failover" if err is None
+                             else "failed")
         if err is not None:
+            if ticket.tctx is not None:
+                self._emit_ticket_spans(ticket, ticket.result,
+                                        attempt_done=failed_attempt
+                                        is not None)
             ticket.done.set()
             if ticket.journaled:
                 self._journal_terminal(ticket, ticket.result)
@@ -1803,6 +1944,13 @@ class RouterServer:
             self.metrics.counter("router.journal_replays").inc()
             self.metrics.event("router.journal_replay",
                                key=rec.get("key"))
+            # Rejoin the original trace: the accept record carried the
+            # dead incarnation's router.request span, so this replay's
+            # span becomes its child — crash-recovery chains render as
+            # ONE tree across (pid, rid) incarnations.
+            tctx = tracing_mod.TraceContext.from_dict(rec.get("trace"))
+            if tctx is not None:
+                req.trace_ctx = tctx
             ticket = self._route(req, rec.get("key"))
             if ticket.journaled:
                 # The fresh accept hit the WAL inside _route, so the

@@ -100,6 +100,13 @@ class Request:
     max_queue_steps: int | None = None
     slo_s: float | None = None
     priority: int = 0
+    # Causal-trace context (horovod_tpu.tracing.TraceContext) stamped by
+    # whoever minted or propagated the trace — the router sets it per
+    # delivery attempt so engine spans parent under the right hop; None
+    # (the default) means unsampled and costs one attribute test.
+    # Excluded from the JSON wire schema's REQUIRED fields: it rides
+    # request_to_json/request_from_json as an optional "trace" dict.
+    trace_ctx: Any = None
 
 
 # Terminal request statuses (ServeEngine request lifecycle).

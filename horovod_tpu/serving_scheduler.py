@@ -141,6 +141,7 @@ from horovod_tpu import monitor as monitor_mod
 from horovod_tpu import profiler as profiler_mod
 from horovod_tpu import scheduling as scheduling_mod
 from horovod_tpu import timeseries as timeseries_mod
+from horovod_tpu import tracing as tracing_mod
 from horovod_tpu.metrics import Trace
 from horovod_tpu.models import llama
 from horovod_tpu.parallel.mesh import tensor_parallel_mesh
@@ -425,6 +426,12 @@ class ServeEngine:
         for h in ("serve.ttft_s", "serve.tpot_s", "serve.queue_wait_s",
                   "serve.e2e_s"):
             self.metrics.histogram(h)
+        # Causal tracing plane (horovod_tpu.tracing): spans are emitted
+        # post-hoc from Trace stamps at terminal time, so with sampling
+        # off the hot path pays one None-check per request.
+        self.tracer = tracing_mod.Tracer(self.metrics)
+        self._trace_fraction = tracing_mod.env_sample_fraction()
+        self._trace_seed = tracing_mod.env_trace_seed()
         # Per-tick phase profiler: None = env-driven (HVD_TPU_PROFILE=1).
         # Off means prof is None and every call site is one `is not
         # None` test — the hot path pays nothing.
@@ -887,6 +894,7 @@ class ServeEngine:
                                        slo_deadline=slo_deadline))
         self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
                                  enqueue_step=self.step_index)
+        self._maybe_open_trace(req, rid, self.traces[rid], now)
         self._slo_targets[rid] = req.slo_s
         self.metrics.counter("serve.requests_submitted").inc()
         self.metrics.event("serve.submit", rid=rid, step=self.step_index,
@@ -895,6 +903,31 @@ class ServeEngine:
         if self.timeline is not None:
             self.timeline.async_start("serving.requests", "REQ", rid)
         return rid
+
+    def _maybe_open_trace(self, req: Request, rid: int, tr: Trace,
+                          now: float) -> None:
+        """Join the causal tracing plane at submit: adopt a propagated
+        context (the router's ``replica.attempt`` span) as parent, or
+        head-sample an engine-origin root keyed on ``serve:<rid>`` —
+        a pure function of (seed, rid), so sampling decisions replay
+        bit-identically (HVD010)."""
+        ctx = getattr(req, "trace_ctx", None)
+        if ctx is not None:
+            sctx = ctx.child("serve.request")
+            tr.parent_span_id = ctx.span_id
+        elif self._trace_fraction > 0.0:
+            sctx = tracing_mod.TraceContext.root(
+                f"serve:{rid}", "serve.request",
+                self._trace_fraction, self._trace_seed)
+            if sctx is None:
+                return
+            tracing_mod.count_sampled(self.metrics)
+        else:
+            return
+        tr.trace_id = sctx.trace_id
+        tr.span_id = sctx.span_id
+        self.tracer.span_open(sctx, "serve.request", now,
+                              parent_id=tr.parent_span_id, rid=rid)
 
     def _reject_submit(self, req: Request, L: int) -> int:
         """Terminal ``REJECTED`` for a request invalid on its face
@@ -907,6 +940,7 @@ class ServeEngine:
         now = time.monotonic()
         self.traces[rid] = Trace(rid=rid, enqueue_ts=now,
                                  enqueue_step=self.step_index)
+        self._maybe_open_trace(req, rid, self.traces[rid], now)
         self._slo_targets[rid] = req.slo_s
         self.metrics.counter("serve.requests_submitted").inc()
         self.metrics.event("serve.submit", rid=rid, step=self.step_index,
@@ -1231,7 +1265,10 @@ class ServeEngine:
         res.trace = tr
         self.slo.add(tr, self._slo_targets.pop(rid, None))
         self.metrics.gauge("serve.goodput").set(self.slo.goodput())
-        self.metrics.histogram("serve.e2e_s").observe(tr.e2e_s)
+        self.metrics.histogram("serve.e2e_s").observe(
+            tr.e2e_s, exemplar=tr.trace_id)
+        if tr.trace_id is not None:
+            self._emit_request_spans(tr)
         tpot = tr.tpot_s
         if tpot is not None:
             self.metrics.histogram("serve.tpot_s").observe(tpot)
@@ -1240,6 +1277,52 @@ class ServeEngine:
             self.metrics.counter("serve.tokens_emitted").inc(tr.n_tokens)
         if self.timeline is not None:
             self.timeline.async_end("serving.requests", "REQ", rid)
+
+    def _emit_request_spans(self, tr: Trace) -> None:
+        """Post-hoc span emission for a sampled request at terminal
+        time: ``serve.queue`` / ``serve.prefill`` / ``serve.decode``
+        children tiled from the Trace stamps, then the
+        ``serve.request`` close.  Phases a request never reached
+        (queue-side REJECTED/TIMEOUT) are simply absent."""
+        sctx = tracing_mod.TraceContext(tr.trace_id, tr.span_id)
+        if tr.admit_ts is not None:
+            self.tracer.span(sctx.child("serve.queue"), "serve.queue",
+                             tr.enqueue_ts, tr.admit_ts,
+                             parent_id=tr.span_id, rid=tr.rid,
+                             steps=tr.queue_steps)
+            if tr.first_token_ts is not None:
+                self.tracer.span(
+                    sctx.child("serve.prefill"), "serve.prefill",
+                    tr.admit_ts, tr.first_token_ts,
+                    parent_id=tr.span_id, rid=tr.rid,
+                    chunks=tr.prefill_chunks)
+                self.tracer.span(
+                    sctx.child("serve.decode"), "serve.decode",
+                    tr.first_token_ts, tr.terminal_ts,
+                    parent_id=tr.span_id, rid=tr.rid,
+                    n_tokens=tr.n_tokens, admit_step=tr.admit_step,
+                    terminal_step=tr.terminal_step)
+        self.tracer.span(sctx, "serve.request", tr.enqueue_ts,
+                         tr.terminal_ts, parent_id=tr.parent_span_id,
+                         rid=tr.rid, status=tr.status)
+
+    def _emit_chunk_span(self, tr: Trace, t0: float, t1: float) -> None:
+        """One ``serve.prefill_chunk`` span per dispatched prefill
+        window of a sampled request, parented under the request's
+        ``serve.prefill`` span.  The parent id is *derived* (same
+        ``child_span_id`` the close in :meth:`_emit_request_spans`
+        uses), so chunks emit before their parent exists and still
+        join the tree at reconstruction."""
+        prefill_id = tracing_mod.child_span_id(
+            tr.trace_id, tr.span_id, "serve.prefill")
+        ctx = tracing_mod.TraceContext(
+            tr.trace_id,
+            tracing_mod.child_span_id(tr.trace_id, prefill_id,
+                                      "serve.prefill_chunk",
+                                      seq=tr.prefill_chunks))
+        self.tracer.span(ctx, "serve.prefill_chunk", t0, t1,
+                         parent_id=prefill_id, rid=tr.rid,
+                         seq=tr.prefill_chunks)
 
     def _slot_fault(self, slot: int, exc: BaseException) -> None:
         """Quarantine a prefill-window fault to its own request:
@@ -1440,6 +1523,9 @@ class ServeEngine:
                        else s.base + (w + 1) * self.chunk)
             sel = (s.true_len - 1 - s.base - w * self.chunk
                    if final else 0)
+            tr = self.traces.get(s.request_id)
+            traced = tr is not None and tr.trace_id is not None
+            t_chunk = time.monotonic() if traced else 0.0
             try:
                 self.faults.check("serve.prefill", key=s.request_id)
                 self.pcache, self.last_logits = self._chunk(
@@ -1453,8 +1539,9 @@ class ServeEngine:
                 continue
             s.w_done += 1
             progress += 1
-            tr = self.traces.get(s.request_id)
             if tr is not None:
+                if traced:
+                    self._emit_chunk_span(tr, t_chunk, time.monotonic())
                 tr.prefill_chunks += 1
             if final:
                 s.state = DECODE      # joins this step's tick
@@ -1703,9 +1790,12 @@ def measure_throughput(
     the acceptance bound for the observability layer is < 2 %),
     ``monitor_overhead_pct`` (exporter on and scraped at ~100 Hz),
     ``serve_profiler_overhead_pct`` (phase profiler on — bound < 3 %)
-    and ``serve_health_overhead_pct`` (time-series sampler + alert
+    ``serve_health_overhead_pct`` (time-series sampler + alert
     evaluation in the step loop at 20 Hz — acceptance keeps it within
-    2 % of the monitor baseline) —
+    2 % of the monitor baseline) and ``serve_trace_overhead_pct``
+    (causal span plane at 100 % head sampling vs the None-check
+    disabled plane — prices the worst case; disabled is near-free by
+    construction) —
     all min-of-2 passes against an adjacent min-of-2 metrics-on base,
     so inter-pass drift doesn't masquerade as overhead — with
     ``serve_phase_pct`` / ``serve_phase_mean_ms`` per-phase breakdowns,
@@ -1781,8 +1871,11 @@ def measure_throughput(
     # prices a deliberately aggressive cadence.
     hsampler = timeseries_mod.MetricsSampler(hreg, sample_s=0.05)
     halerts = alerts_mod.AlertManager(hsampler, registry=hreg)
+    treg = metrics_mod.MetricsRegistry(event_log=None)
+    ttracer = tracing_mod.Tracer(treg)
+    orig_tracer, orig_fraction = eng.tracer, eng._trace_fraction
     t_base = t_serve_mon = t_serve_prof = float("inf")
-    t_serve_health = float("inf")
+    t_serve_health = t_serve_trace = float("inf")
     try:
         for _ in range(2):
             # base leg: metrics on, no exporter scrape, no profiler
@@ -1811,10 +1904,21 @@ def measure_throughput(
             t_serve_health = min(t_serve_health, _timed_pass())
             eng.sampler = None
             eng.alerts = None
+            # trace leg: causal span plane ON at 100 % head sampling —
+            # every request opens, closes, and tiles its span set.
+            # This prices the worst case; the disabled plane is one
+            # None-check per request by construction.
+            eng.metrics = treg
+            eng.tracer = ttracer
+            eng._trace_fraction = 1.0
+            t_serve_trace = min(t_serve_trace, _timed_pass())
+            eng._trace_fraction = orig_fraction
     finally:
         eng.prof = None
         eng.sampler = None
         eng.alerts = None
+        eng.tracer = orig_tracer
+        eng._trace_fraction = orig_fraction
         stop_scraping.set()
         scraper.join(timeout=5)
         mon.stop()
@@ -1870,6 +1974,8 @@ def measure_throughput(
             (t_serve_prof - t_base) / t_base * 100.0,
         "serve_health_overhead_pct":
             (t_serve_health - t_base) / t_base * 100.0,
+        "serve_trace_overhead_pct":
+            (t_serve_trace - t_base) / t_base * 100.0,
         "serve_phase_pct": {
             p: prof_report["phases"][p]["pct_of_tick"]
             for p in profiler_mod.PHASES},

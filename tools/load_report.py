@@ -93,6 +93,13 @@ def render(report: dict) -> str:
             v = phases.get(p, 0.0)
             share = (v / mean_e2e * 100.0) if mean_e2e else 0.0
             lines.append(f"  {p:18s} {v * 1e3:9.3f} ms {share:6.1f}%")
+        exemplars = (report.get("knee_exemplar_trace_ids")
+                     or rungs[knee_i].get("exemplar_trace_ids") or [])
+        if exemplars:
+            lines.append("knee exemplar traces (slowest sampled "
+                         "requests; feed to tools/trace_report.py):")
+            for tid in exemplars:
+                lines.append(f"  {tid}")
     return "\n".join(lines)
 
 
