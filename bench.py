@@ -1060,6 +1060,68 @@ def _bench_serve_simfleet(hvd, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_serve_device(hvd, on_tpu: bool) -> dict:
+    """Device telemetry arm (extras, TPU only): the serving workload
+    through ``measure_throughput``'s device leg — telemetry plane ON
+    (XLA cost-model dispatch stamping, device_sync split, per-step
+    gauge refresh) against the interleaved min-of-2 metrics-on base.
+    Reports the serving MFU (honest ``None`` on CPU rehearsals — no
+    peak table entry, so no MFU; the ``serve_device_peak_known`` flag
+    says which case a round was), the cost-model FLOPs per emitted
+    token (a pure model/workload property, platform-independent), and
+    what the plane itself costs (acceptance bound < 5 %)."""
+    if not on_tpu:
+        return {}
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+    from horovod_tpu.serving import Request
+    from horovod_tpu.serving_scheduler import measure_throughput
+
+    if os.environ.get("HVD_TPU_BENCH_FORCE_TPU_PATHS") == "1":
+        # Rehearsal (CPU stand-in): tiny config, same code path.
+        cfg = llama.llama_tiny(attn_impl="dense", dtype=jnp.float32)
+        n_slots, max_len, chunk = 2, 32, 8
+        shapes = [(4, 12), (3, 2), (9, 2), (2, 10), (5, 3), (6, 8)]
+    else:
+        cfg = llama.llama_tiny(
+            vocab_size=32768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048,
+            attn_impl="dense",
+        )
+        n_slots, max_len, chunk = 8, 512, 64
+        rng = np.random.RandomState(7)
+        shapes = [(int(rng.randint(8, 192)), int(rng.choice([4, 8, 192])))
+                  for _ in range(32)]
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(11)
+    reqs = [Request(prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size, size=pl)],
+                    max_new_tokens=new)
+            for pl, new in shapes]
+    r = measure_throughput(params, cfg, reqs, n_slots=n_slots,
+                           max_len=max_len, chunk=chunk)
+    mfu = r["serve_mfu"]
+    return {
+        # None stays None in the artifact — a CPU rehearsal must never
+        # read as "0.0 MFU" in round-over-round comparison.
+        "serve_mfu": None if mfu is None else round(mfu, 4),
+        "serve_device_peak_known": r["device_peak_flops_known"],
+        "serve_model_flops_per_token": round(
+            r["serve_model_flops_per_token"], 1),
+        "serve_device_flops_per_s": round(
+            r["serve_device_flops_per_s"], 1),
+        "serve_overlap_headroom_pct": round(
+            r["serve_overlap_headroom_pct"], 2),
+        "device_telemetry_overhead_pct": round(
+            r["device_telemetry_overhead_pct"], 2),
+        "serve_device_shape": (f"s{n_slots}_len{max_len}_chunk{chunk}_"
+                               f"req{len(reqs)}"),
+    }
+
+
 def _bench_resnet101_big_batch(hvd, on_tpu: bool) -> dict:
     """MFU-ceiling probe (extras arm, TPU only, runs last): the primary
     metric keeps the reference's bs-64 config for apples-to-apples, but a
@@ -1567,6 +1629,7 @@ def _worker_main(mode: str, status_path: str | None) -> None:
                _bench_serve_spec, _bench_serve_tp, _bench_serve_router,
                _bench_serve_chaos, _bench_serve_load,
                _bench_serve_autoscale, _bench_serve_simfleet,
+               _bench_serve_device,
                _bench_resnet101_big_batch,
                _bench_llama, _bench_llama_fused,
                _bench_resnet50, _bench_llama_decode, _bench_vit):
@@ -1921,9 +1984,84 @@ def _simfleet_preflight() -> None:
     _note("simfleet preflight ok (oracles green, no regression)")
 
 
+_DEVICE_PREFLIGHT_SCRIPT = """
+import json, sys
+import jax, numpy as np
+from horovod_tpu.models import llama
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.serving import Request
+from horovod_tpu.serving_scheduler import ServeEngine
+cfg = llama.llama_tiny(attn_impl="dense", dtype=jax.numpy.float32)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+eng = ServeEngine(params, cfg, n_slots=2, max_len=32, chunk=8,
+                  metrics=metrics_mod.MetricsRegistry(event_log=None),
+                  monitor=False, device_telemetry=True)
+rng = np.random.RandomState(11)
+reqs = [Request(prompt=[int(t) for t in
+                        rng.randint(1, cfg.vocab_size, size=pl)],
+                max_new_tokens=new)
+        for pl, new in [(4, 12), (3, 2), (9, 2), (2, 10), (5, 3), (6, 8)]]
+eng.run(reqs)
+with open(sys.argv[1], "w") as f:
+    json.dump(eng.metrics_snapshot()["device"], f)
+"""
+
+
+def _device_preflight() -> None:
+    """CPU-rehearsal device-telemetry smoke + regression gate before any
+    TPU window is spent: a tiny telemetry-on engine serves a fixed
+    queue, dumps its device report, and ``perf_gate.py --device`` diffs
+    it against the cached baseline (the simfleet-preflight pattern).
+    On CPU the MFU axis is honestly absent, so the gate judges achieved
+    FLOPs/s / headroom / host stall — wall-clock-noisy at smoke scale,
+    hence the loose 50 % threshold: this catches the plane breaking or
+    collapsing, not single-digit drift.  Advisory only."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache = os.environ.get("HVD_TPU_BENCH_CACHE") or here
+    baseline = os.path.join(cache, "device_report.json")
+    fresh = os.path.join(cache, "device_report.new.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _DEVICE_PREFLIGHT_SCRIPT, fresh],
+            cwd=here, capture_output=True, text=True, timeout=180,
+            env=env)
+    except Exception as exc:  # noqa: BLE001 — smoke must never raise
+        _note(f"DEVICE PREFLIGHT BROKEN: engine did not run ({exc!r})")
+        return
+    if out.returncode != 0 or not os.path.exists(fresh):
+        _note("DEVICE PREFLIGHT FAILED: telemetry-on engine broke — "
+              "run tools/device_report.py locally")
+        return
+    if os.path.exists(baseline):
+        try:
+            cmp_out = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "tools", "perf_gate.py"),
+                 "--device", baseline, fresh, "--threshold", "50"],
+                cwd=here, capture_output=True, text=True, timeout=60)
+        except Exception as exc:  # noqa: BLE001
+            _note(f"DEVICE PREFLIGHT BROKEN: compare did not run "
+                  f"({exc!r})")
+            return
+        if cmp_out.returncode != 0:
+            _note("DEVICE PREFLIGHT REGRESSION: "
+                  + "; ".join(l.strip()
+                              for l in cmp_out.stdout.splitlines()
+                              if "REGRESSION:" in l))
+            return
+    try:
+        os.replace(fresh, baseline)
+    except OSError:
+        pass                        # read-only cache: gate still ran
+    _note("device preflight ok (plane live, no regression)")
+
+
 def _orchestrate() -> None:
     _lint_preflight()
     _simfleet_preflight()
+    _device_preflight()
     hard_limit = float(os.environ.get("HVD_TPU_BENCH_HARD_LIMIT", "840"))
     claim_timeout = float(os.environ.get("HVD_TPU_BENCH_CLAIM_TIMEOUT", "60"))
     attempts = int(os.environ.get("HVD_TPU_BENCH_PROBE_ATTEMPTS", "5"))

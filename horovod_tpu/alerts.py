@@ -116,6 +116,15 @@ ALERT_RULES = (
      "pending_s": 0.0, "clear_s": 300.0,
      "help": "Three or more autoscaler actuations inside 10 m -- the "
              "fleet is resizing faster than demand can justify."},
+    {"name": "device_hbm_exhaustion", "severity": "page",
+     "kind": "threshold", "metric": "device.hbm_used_fraction",
+     "above": 0.92, "window_s": 30.0,
+     "pending_s": 10.0, "clear_s": 60.0,
+     "help": "Device HBM use above 92% of bytes_limit sustained over "
+             "30 s -- the next allocation spike OOMs the replica. "
+             "Needs the device telemetry plane; CPU backends report "
+             "no memory_stats, so the series is absent and the rule "
+             "holds state."},
 )
 
 

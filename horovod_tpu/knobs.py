@@ -82,6 +82,10 @@ ENV_KNOBS = (
      "Replicas added or retired per autoscaler action at most."),
     ("HVD_TPU_BENCH_CACHE", "",
      "Directory for cached benchmark baselines (default: repo-local)."),
+    ("HVD_TPU_DEVICE_POLL_S", "1.0",
+     "Seconds between device memory_stats() polls (HBM gauges)."),
+    ("HVD_TPU_DEVICE_TELEMETRY", "0",
+     "Device telemetry plane in ServeEngine (cost model, MFU, HBM)."),
     ("HVD_TPU_DRAFT_K", "4",
      "Draft tokens proposed per slot per tick when speculation is on."),
     ("HVD_TPU_EVENT_LOG", "",
@@ -105,6 +109,8 @@ ENV_KNOBS = (
      "Port for the per-rank /metrics + /healthz HTTP exporter."),
     ("HVD_TPU_NEGOTIATE_TIMEOUT_S", "60",
      "Host-card negotiation deadline in seconds during init()."),
+    ("HVD_TPU_PEAK_FLOPS", "",
+     "Per-chip peak FLOP/s override for the serving-MFU denominator."),
     ("HVD_TPU_PROFILE", "0",
      "Per-tick phase profiling in ServeEngine (serve.phase.* metrics)."),
     ("HVD_TPU_PROFILE_WINDOW", "256",

@@ -134,6 +134,9 @@ DETERMINISM_SURFACES: tuple = (
      "seeded chaos-at-scale campaign diffed by the --compare gate"),
     ("trace-sampling", "horovod_tpu/tracing.py", "sampled",
      "head-sampling decision is a pure function of (seed, request id)"),
+    ("device-replay", "horovod_tpu/device_telemetry.py",
+     "report_from_events",
+     "device report rebuilt from the event log must match the live scrape"),
 )
 
 #: Canonical one-line descriptions for every registry metric the codebase
@@ -192,6 +195,8 @@ METRIC_HELP: dict[str, str] = {
     "serve.phase.draft_s": "Tick phase: prompt-lookup draft proposal (spec engines)",
     "serve.phase.decode_dispatch_s": "Tick phase: host time dispatching the decode tick",
     "serve.phase.device_sync_s": "Tick phase: blocking token readback (device wait)",
+    "serve.phase.device_sync_compute_est_s": "Device-sync sub-phase: cost-model-predicted device compute share",
+    "serve.phase.device_sync_host_stall_s": "Device-sync sub-phase: readback wait beyond predicted device time",
     "serve.phase.verify_s": "Tick phase: acceptance + token emission (spec engines)",
     "serve.phase.sample_postprocess_s": "Tick phase: per-slot token handling and retirement",
     "serve.phase.bookkeeping_s": "Tick phase: counters, gauges, sentry, watchdog",
@@ -288,6 +293,23 @@ METRIC_HELP: dict[str, str] = {
     # trace.* — the causal span-tree plane (horovod_tpu.tracing)
     "trace.sampled": "Requests head-sampled into the tracing plane at a root",
     "trace.spans": "Closed trace.span records emitted to the event log",
+    # serve.mfu / device.* — the device telemetry plane
+    # (horovod_tpu.device_telemetry): XLA cost model, compile ledger,
+    # HBM polling, and the transfer/dispatch split.  The conditional
+    # gauges (serve.mfu, device.bytes_in_use, ...) are minted only when
+    # their value is honestly known — absent beats a fabricated zero.
+    "serve.mfu": "Windowed achieved model FLOPs over the platform peak (absent when no peak is known)",
+    "serve.arithmetic_intensity": "Windowed cost-model FLOPs per byte accessed across dispatched programs",
+    "device.compiles": "XLA program compilations observed (AOT captures plus sentry-detected retraces)",
+    "device.compile_s": "Seconds one XLA program compilation took (AOT capture wall time)",
+    "device.model_flops": "Cost-model FLOPs dispatched to the device across all pinned programs",
+    "device.h2d_bytes": "Host-to-device bytes of per-call program arguments stamped at dispatch",
+    "device.d2h_bytes": "Device-to-host bytes read back at the device_sync boundary",
+    "device.bytes_in_use": "Device memory in use per memory_stats() (absent when the backend has none)",
+    "device.peak_bytes_in_use": "High-water device memory per memory_stats() (absent when the backend has none)",
+    "device.hbm_used_fraction": "bytes_in_use over bytes_limit (absent without a device memory limit)",
+    "device.overlap_headroom_pct": "Windowed predicted device-compute share of wall time (the double-buffering ceiling)",
+    "device.peak_flops_known": "1 when the platform peak-FLOPs table (or override) knows this device, else 0",
 }
 
 

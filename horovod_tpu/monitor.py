@@ -146,6 +146,17 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, json.dumps(prof.report()),
                             "application/json")
+        elif path == "/device":
+            dev = getattr(mon.engine, "device", None)
+            if dev is None:
+                self._reply(
+                    404, "device telemetry off; construct the engine "
+                         "with device_telemetry=True or set "
+                         "HVD_TPU_DEVICE_TELEMETRY=1\n",
+                    "text/plain")
+            else:
+                self._reply(200, json.dumps(dev.report()),
+                            "application/json")
         elif path == "/timeseries":
             sampler = getattr(mon.engine, "sampler", None)
             if sampler is None:
@@ -186,8 +197,8 @@ class _Handler(BaseHTTPRequestHandler):
                             "application/json")
         else:
             self._reply(404, "unknown path; try /metrics /snapshot "
-                             "/healthz /state /profile /timeseries "
-                             "/alerts /advice /traces\n",
+                             "/healthz /state /profile /device "
+                             "/timeseries /alerts /advice /traces\n",
                         "text/plain")
 
     def log_message(self, fmt: str, *args: Any) -> None:
@@ -231,7 +242,7 @@ class MonitorServer:
 
     _SCRAPE_ENDPOINTS = frozenset(
         {"metrics", "snapshot", "healthz", "state", "profile",
-         "timeseries", "alerts", "advice", "traces", "root"})
+         "device", "timeseries", "alerts", "advice", "traces", "root"})
 
     def _scrape_obs(self, endpoint: str) -> tuple[Any, Any]:
         """(latency histogram, error counter) for one endpoint, created

@@ -61,8 +61,12 @@ PHASES = ("expire", "admit", "decode_dispatch", "device_sync",
 SPEC_PHASES = ("draft", "verify")
 
 #: Nested sub-phases (explicit intervals inside a parent phase).  They
-#: overlap their parent, so coverage math skips them.
-SUB_PHASES = ("admit.cache_acquire", "admit.prefill_dispatch")
+#: overlap their parent, so coverage math skips them.  The
+#: ``device_sync`` pair is the device-telemetry split of the readback
+#: wait: cost-model-predicted device compute vs host stall (only
+#: emitted when the engine runs with ``device_telemetry``).
+SUB_PHASES = ("admit.cache_acquire", "admit.prefill_dispatch",
+              "device_sync.compute_est", "device_sync.host_stall")
 
 _DEFAULT_WINDOW = 256
 
@@ -120,6 +124,10 @@ class TickProfiler:
             "decode_dispatch":
                 metrics.histogram("serve.phase.decode_dispatch_s"),
             "device_sync": metrics.histogram("serve.phase.device_sync_s"),
+            "device_sync.compute_est": metrics.histogram(
+                "serve.phase.device_sync_compute_est_s"),
+            "device_sync.host_stall": metrics.histogram(
+                "serve.phase.device_sync_host_stall_s"),
             "verify": metrics.histogram("serve.phase.verify_s"),
             "sample_postprocess":
                 metrics.histogram("serve.phase.sample_postprocess_s"),

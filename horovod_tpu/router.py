@@ -899,15 +899,26 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self._reply(200,
                                 json.dumps(router.autoscaler.report()),
                                 "application/json")
+            elif path == "/device":
+                rep = router.device_report()
+                if not rep["replicas"]:
+                    self._reply(404, "no replica exposes device "
+                                     "telemetry; construct engines "
+                                     "with device_telemetry=True or "
+                                     "set HVD_TPU_DEVICE_TELEMETRY=1"
+                                     "\n", "text/plain")
+                else:
+                    self._reply(200, json.dumps(rep),
+                                "application/json")
             elif path == "/traces":
                 self._reply(200, json.dumps(router.tracer.recent()),
                             "application/json")
             else:
                 self._reply(404, "unknown path; try /v1/generate "
                                  "/replicas /snapshot /healthz "
-                                 "/metrics /state /timeseries "
-                                 "/alerts /advice /autoscaler "
-                                 "/traces\n",
+                                 "/metrics /state /device "
+                                 "/timeseries /alerts /advice "
+                                 "/autoscaler /traces\n",
                             "text/plain")
         except BrokenPipeError:
             pass
@@ -2159,6 +2170,42 @@ class RouterServer:
                     "shadow_paths": len(shadow),
                     "shadow_block_size": shadow.block_size,
                 })
+        return out
+
+    def device_report(self) -> dict:
+        """``GET /device``: fleet view of per-replica device telemetry.
+        Only in-process :class:`LocalReplica` engines expose the plane
+        directly (an HTTP replica's ``/device`` lives on its own
+        monitor); replicas without telemetry are listed by name so the
+        fleet summary is honest about its coverage.  MFU aggregates
+        skip replicas with no honest peak — the summary's ``mfu_*``
+        keys are present only when at least one replica reports one."""
+        with self._lock:
+            handles = list(self.replicas)
+        per: dict[str, dict] = {}
+        without: list[str] = []
+        for r in handles:
+            dev = getattr(getattr(r, "engine", None), "device", None)
+            if dev is None:
+                without.append(r.name)
+            else:
+                per[r.name] = dev.report()
+        out: dict[str, Any] = {
+            "replicas": per,
+            "without_telemetry": sorted(without),
+        }
+        mfus = [rep["win"]["mfu"] for rep in per.values()
+                if rep["win"]["mfu"] is not None]
+        summary: dict[str, Any] = {
+            "n_reporting": len(per),
+            "fleet_flops_per_s": sum(
+                rep["win"]["flops_per_s"] for rep in per.values()),
+        }
+        if mfus:
+            summary["mfu_min"] = min(mfus)
+            summary["mfu_max"] = max(mfus)
+            summary["mfu_mean"] = sum(mfus) / len(mfus)
+        out["summary"] = summary
         return out
 
     def memory_report(self) -> dict:

@@ -134,6 +134,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from horovod_tpu import alerts as alerts_mod
+from horovod_tpu import device_telemetry as device_telemetry_mod
 from horovod_tpu import drafting as drafting_mod
 from horovod_tpu import faults as faults_mod
 from horovod_tpu import metrics as metrics_mod
@@ -332,6 +333,9 @@ class ServeEngine:
                  sampler: "timeseries_mod.MetricsSampler | bool | None"
                      = None,
                  alerts: "alerts_mod.AlertManager | bool | None"
+                     = None,
+                 device_telemetry:
+                     "device_telemetry_mod.DeviceTelemetry | bool | None"
                      = None):
         if chunk < 1 or chunk > max_len:
             raise ValueError(f"chunk {chunk} must be in [1, max_len "
@@ -440,6 +444,22 @@ class ServeEngine:
         self.prof = (profiler_mod.TickProfiler(
             self.metrics, timeline=timeline, window=profile_window)
             if profile else None)
+        # Device telemetry plane (horovod_tpu.device_telemetry): XLA
+        # cost model + compile ledger + HBM polling + the device_sync
+        # compute/stall split.  None = env-driven
+        # (HVD_TPU_DEVICE_TELEMETRY=1), False = off, True = on, an
+        # instance is used as-is.  Off means device is None and every
+        # hot-path call site is one `is not None` test.
+        if device_telemetry is False:
+            self.device = None
+        elif device_telemetry is None:
+            self.device = device_telemetry_mod.maybe_telemetry(
+                self.metrics, n_devices=tp_size)
+        elif device_telemetry is True:
+            self.device = device_telemetry_mod.DeviceTelemetry(
+                self.metrics, n_devices=tp_size)
+        else:
+            self.device = device_telemetry
         # Retrace sentry: the dynamic complement to hvdlint HVD001 —
         # compile_cache_sizes() is diffed every step and any mid-serve
         # growth bumps serve.retrace (fatal under HVD_TPU_RETRACE_FATAL=1).
@@ -633,6 +653,12 @@ class ServeEngine:
         self._tick = _tick
         self._chunk = _chunk
         self._set_row = _set_row
+        # Device cost-model capture happens BEFORE the sentry baseline
+        # on purpose: AOT lowering never mints jit call-cache entries,
+        # and taking the baseline after it proves that property every
+        # construction (the sentry would flag any drift immediately).
+        if self.device is not None:
+            self._device_capture_programs(self.device)
         # Sentry baseline: all zeros pre-warmup.  The first compile of
         # each program (0 -> 1) is legitimate; the sentry only counts
         # growth BEYOND one signature per program.
@@ -653,6 +679,40 @@ class ServeEngine:
         if self._spec_tick is not None:
             sizes["spec_tick"] = self._spec_tick._cache_size()
         return sizes
+
+    def _device_capture_programs(
+            self, dev: "device_telemetry_mod.DeviceTelemetry") -> None:
+        """AOT-capture the XLA cost model of every pinned program into
+        ``dev`` (FLOPs / bytes-accessed / compile wall time per
+        dispatch) and hand it the exact model-side device bytes for HBM
+        reconciliation.  Built from ``ShapeDtypeStruct`` avals of the
+        live arrays, so each capture lowers the very signature serving
+        will call — and ``jitfn.lower()`` never touches the jit call
+        cache, so ``compile_cache_sizes()`` is identical telemetry-on
+        vs off (pinned by tests/test_device_telemetry.py)."""
+        aval = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+        p_av = jax.tree.map(aval, self.params)
+        c_av = jax.tree.map(aval, self.pcache)
+        ll_av = aval(self.last_logits)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        active_av = jax.ShapeDtypeStruct((self.n_slots,), jnp.int32)
+        toks_av = jax.ShapeDtypeStruct((1, self.chunk), jnp.int32)
+        row_av = jax.ShapeDtypeStruct((self.blocks_per_slot,), jnp.int32)
+        dev.capture("tick", self._tick, p_av, c_av, ll_av, active_av)
+        dev.capture("chunk", self._chunk, p_av, c_av, ll_av, toks_av,
+                    i32, i32, i32)
+        dev.capture("set_row", self._set_row, c_av, i32, row_av, i32)
+        if self._spec_tick is not None:
+            drafts_av = jax.ShapeDtypeStruct(
+                (self.n_slots, self.draft_k), jnp.int32)
+            dev.capture("spec_tick", self._spec_tick, p_av, c_av,
+                        ll_av, drafts_av, active_av)
+        param_bytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree.leaves(self.params))
+        dev.set_model_bytes(
+            param_bytes=param_bytes,
+            kv_total_bytes=self._block_bytes * self.pcache.k.shape[1])
 
     def free_block_count(self) -> int:
         return len(self._free_blocks)
@@ -683,6 +743,8 @@ class ServeEngine:
             snap["prefix"] = self.prefix.key_digest()
         if self.prof is not None:
             snap["profile"] = self.prof.report()
+        if self.device is not None:
+            snap["device"] = self.device.report()
         if self.sampler is not None:
             # Trailing points only: the full rings stay behind the
             # /timeseries endpoint; snapshots ride merge_snapshots and
@@ -817,6 +879,18 @@ class ServeEngine:
                     f"{p}={rep['phases'][p]['mean_s'] * 1e3:.3f}"
                     for p in rep["phases"] if "." not in p)
                 + f" tick={rep['tick']['mean_s'] * 1e3:.3f}")
+        if self.device is not None:
+            drep = self.device.report()
+            mfu = drep["win"]["mfu"]
+            lines.append(
+                f"  device: {drep['platform']}/{drep['device_kind']}"
+                f" x{drep['n_devices']}"
+                f" peak_known={drep['peak_flops_known']}"
+                f" mfu={'n/a' if mfu is None else f'{mfu:.4f}'}"
+                f" flops/s={drep['win']['flops_per_s']:.3e}"
+                f" headroom={drep['win']['overlap_headroom_pct']:.1f}%"
+                f" compiles={drep['compiles']}"
+                f" retrace_est_s={drep['retrace_compile_est_s']:.3f}")
         lines += ["  " + ln for ln in self.pool.state_lines()]
         if self.prefix is not None:
             lines.append(
@@ -991,6 +1065,8 @@ class ServeEngine:
             blocks.append(b)
         row = self._trash_row.copy()
         row[:need] = blocks
+        if self.device is not None:
+            self.device.dispatch("set_row", h2d_bytes=row.nbytes + 8)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(row), jnp.asarray(base, jnp.int32))
@@ -1160,6 +1236,9 @@ class ServeEngine:
             deadline=s.deadline,
             slo_deadline=s.slo_deadline)
         self._release_row_blocks(s, register=True)
+        if self.device is not None:
+            self.device.dispatch(
+                "set_row", h2d_bytes=self._trash_row.nbytes + 8)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(self._trash_row), jnp.asarray(0, jnp.int32))
@@ -1208,6 +1287,9 @@ class ServeEngine:
         self._finished[s.request_id] = res
         self._finalize_trace(s.request_id, res)
         self._release_row_blocks(s, register=status == OK)
+        if self.device is not None:
+            self.device.dispatch(
+                "set_row", h2d_bytes=self._trash_row.nbytes + 8)
         self.pcache = self._set_row(
             self.pcache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(self._trash_row), jnp.asarray(0, jnp.int32))
@@ -1537,6 +1619,11 @@ class ServeEngine:
                 self._slot_fault(slot, exc)
                 progress += 1
                 continue
+            if self.device is not None:
+                # chunk args materialized per call: the token window
+                # plus three int32 scalars (slot / new_len / sel).
+                self.device.dispatch("chunk",
+                                     h2d_bytes=toks.nbytes + 12)
             s.w_done += 1
             progress += 1
             if tr is not None:
@@ -1593,14 +1680,38 @@ class ServeEngine:
                     tok, self.last_logits, self.pcache = self._tick(
                         self.params, self.pcache, self.last_logits,
                         jnp.asarray(active))
+                if self.device is not None:
+                    self.device.dispatch(
+                        "spec_tick" if spec else "tick",
+                        h2d_bytes=active.nbytes + (
+                            drafts_host.nbytes if spec else 0))
                 if prof is not None:
                     prof.mark("decode_dispatch")
                 # np.asarray on the device token array is the readback
                 # boundary: everything the tick queued must complete
                 # first, so this wait is the device-time share.
+                t_sync0 = time.perf_counter()
                 tok_host = np.asarray(tok)
                 if spec:
                     accept_host = np.asarray(accept)
+                if self.device is not None:
+                    # split the measured readback wait into the cost
+                    # model's predicted device-compute share vs host
+                    # stall; the profiler gets the same split as nested
+                    # device_sync.* intervals so phase tables can show
+                    # where the wait went.
+                    t_sync1 = time.perf_counter()
+                    d2h = tok_host.nbytes + (
+                        accept_host.nbytes
+                        if accept_host is not None else 0)
+                    est, stall = self.device.on_sync(
+                        "spec_tick" if spec else "tick",
+                        t_sync0, t_sync1, d2h_bytes=d2h)
+                    if prof is not None:
+                        prof.add("device_sync.compute_est",
+                                 t_sync0, t_sync0 + est)
+                        prof.add("device_sync.host_stall",
+                                 t_sync0 + est, t_sync1)
                 if prof is not None:
                     prof.mark("device_sync")
             except Exception as exc:
@@ -1715,6 +1826,10 @@ class ServeEngine:
         if grew:
             n = sum(v - max(prev, 1) for prev, v in grew.values())
             self.metrics.counter("serve.retrace").inc(n)
+            if self.device is not None:
+                # compile ledger: charge the growth with the captured
+                # per-program compile cost — retraces become seconds.
+                self.device.on_retrace(grew)
             self.metrics.event(
                 "serve.retrace", step=self.step_index,
                 programs={k: {"before": prev, "after": v}
@@ -1746,6 +1861,8 @@ class ServeEngine:
             self.sampler.tick()
             if self.alerts is not None:
                 self.alerts.tick()
+        if self.device is not None:
+            self.device.on_step(self.step_index)
         self._last_step_ts = time.monotonic()
         self.step_index += 1
         if prof is not None:
@@ -1795,7 +1912,12 @@ def measure_throughput(
     2 % of the monitor baseline) and ``serve_trace_overhead_pct``
     (causal span plane at 100 % head sampling vs the None-check
     disabled plane — prices the worst case; disabled is near-free by
-    construction) —
+    construction) and ``device_telemetry_overhead_pct`` (device
+    telemetry plane ON: cost-model dispatch stamping, sync split, and
+    per-step gauge refresh — bound < 5 %; its leg also yields
+    ``serve_mfu`` — honest ``None`` when no peak is known, i.e. every
+    CPU rehearsal — ``serve_model_flops_per_token``,
+    ``serve_device_flops_per_s`` and ``serve_overlap_headroom_pct``) —
     all min-of-2 passes against an adjacent min-of-2 metrics-on base,
     so inter-pass drift doesn't masquerade as overhead — with
     ``serve_phase_pct`` / ``serve_phase_mean_ms`` per-phase breakdowns,
@@ -1873,9 +1995,15 @@ def measure_throughput(
     halerts = alerts_mod.AlertManager(hsampler, registry=hreg)
     treg = metrics_mod.MetricsRegistry(event_log=None)
     ttracer = tracing_mod.Tracer(treg)
+    dreg = metrics_mod.MetricsRegistry(event_log=None)
+    dtel = device_telemetry_mod.DeviceTelemetry(dreg, n_devices=eng.tp_size)
+    # Cost-model capture (AOT compiles) happens OUTSIDE the timed
+    # passes — it is a construction-time cost in the shipping config
+    # too, not a per-tick one.
+    eng._device_capture_programs(dtel)
     orig_tracer, orig_fraction = eng.tracer, eng._trace_fraction
     t_base = t_serve_mon = t_serve_prof = float("inf")
-    t_serve_health = t_serve_trace = float("inf")
+    t_serve_health = t_serve_trace = t_serve_dev = float("inf")
     try:
         for _ in range(2):
             # base leg: metrics on, no exporter scrape, no profiler
@@ -1913,16 +2041,26 @@ def measure_throughput(
             eng._trace_fraction = 1.0
             t_serve_trace = min(t_serve_trace, _timed_pass())
             eng._trace_fraction = orig_fraction
+            # device leg: cost-model dispatch stamping + sync split +
+            # per-step gauge refresh ON (acceptance bound < 5 %).
+            eng.metrics = dreg
+            eng.device = dtel
+            dev_flops0 = dtel.total_flops
+            t_serve_dev = min(t_serve_dev, _timed_pass())
+            dev_pass_flops = dtel.total_flops - dev_flops0
+            eng.device = None
     finally:
         eng.prof = None
         eng.sampler = None
         eng.alerts = None
+        eng.device = None
         eng.tracer = orig_tracer
         eng._trace_fraction = orig_fraction
         stop_scraping.set()
         scraper.join(timeout=5)
         mon.stop()
     prof_report = prof.report()
+    dev_report = dtel.report()
 
     # static baseline: batches of n_slots, one compiled generate per
     # distinct batch budget (compiles excluded by per-batch warmup)
@@ -1976,6 +2114,16 @@ def measure_throughput(
             (t_serve_health - t_base) / t_base * 100.0,
         "serve_trace_overhead_pct":
             (t_serve_trace - t_base) / t_base * 100.0,
+        "device_telemetry_overhead_pct":
+            (t_serve_dev - t_base) / t_base * 100.0,
+        # honest MFU: None on platforms with no known peak (every CPU
+        # rehearsal) — consumers must not coerce it to 0.
+        "serve_mfu": dev_report["win"]["mfu"],
+        "serve_model_flops_per_token": dev_pass_flops / n_tokens,
+        "serve_device_flops_per_s": dev_report["win"]["flops_per_s"],
+        "serve_overlap_headroom_pct":
+            dev_report["win"]["overlap_headroom_pct"],
+        "device_peak_flops_known": dev_report["peak_flops_known"],
         "serve_phase_pct": {
             p: prof_report["phases"][p]["pct_of_tick"]
             for p in profiler_mod.PHASES},

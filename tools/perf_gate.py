@@ -1,13 +1,14 @@
 """One exit-coded perf-CI verdict over every regression gate.
 
-The repo grew six ``--compare`` gates, one per observability plane:
+The repo grew seven ``--compare`` gates, one per observability plane:
 ``profile_report`` (per-phase tick time), ``load_report`` (saturation
 knee + p99 TTFT + attribution coverage), ``chaos_run`` (recovery
 oracles + OK fraction), ``health_report`` (alert hygiene),
-``simfleet_run`` (fleet-scale control-plane campaigns), and
-``trace_report`` (critical-path composition).  This tool folds any
-subset of them into ONE verdict table and ONE exit code — the shape a
-CI job wants:
+``simfleet_run`` (fleet-scale control-plane campaigns),
+``trace_report`` (critical-path composition), and ``device_report``
+(serving MFU / achieved FLOPs-per-second / overlap headroom / host
+stall).  This tool folds any subset of them into ONE verdict table and
+ONE exit code — the shape a CI job wants:
 
     python tools/perf_gate.py \\
         --profile old_prof.json new_prof.json \\
@@ -16,6 +17,7 @@ CI job wants:
         --health old_health.json new_health.json \\
         --simfleet old_sim.json new_sim.json \\
         --trace old_trace.json new_trace.json \\
+        --device old_dev.json new_dev.json \\
         [--threshold 10] [--json]
 
 Each flag takes the OLD and NEW saved report JSONs its tool's own
@@ -74,6 +76,13 @@ def _gate_trace(old: str, new: str, threshold: float):
         threshold_pct=threshold))
 
 
+def _gate_device(old: str, new: str, threshold: float):
+    import device_report
+    return _rows_verdict(device_report.compare_reports(
+        device_report.load_report(old), device_report.load_report(new),
+        threshold_pct=threshold))
+
+
 def _gate_chaos(old: str, new: str, threshold: float):
     from horovod_tpu.chaos import compare_campaigns
     return compare_campaigns(_load(old), _load(new),
@@ -99,6 +108,7 @@ GATES = {
     "health": _gate_health,
     "simfleet": _gate_simfleet,
     "trace": _gate_trace,
+    "device": _gate_device,
 }
 
 
@@ -139,7 +149,7 @@ def main(argv=None) -> int:
              if getattr(args, name)}
     if not pairs:
         ap.error("supply at least one gate (--profile/--load/--chaos/"
-                 "--health/--simfleet/--trace OLD NEW)")
+                 "--health/--simfleet/--trace/--device OLD NEW)")
     verdict = run_gates(pairs, threshold=args.threshold)
     if args.json:
         print(json.dumps(verdict, indent=2))

@@ -129,7 +129,17 @@ def render(report: dict) -> str:
         f"{'mean ms':>9s} {'max ms':>9s} {'% tick':>7s}",
     ]
     phases = report.get("phases", {})
-    order = sorted(phases, key=lambda p: -phases[p]["total_s"])
+    # Top-level phases by descending total, each followed by its OWN
+    # nested sub-phases (device_sync.compute_est under device_sync,
+    # admit.* under admit) so the indentation reads as containment.
+    order = []
+    for p in sorted((p for p in phases if _is_top_level(p)),
+                    key=lambda p: -phases[p]["total_s"]):
+        order.append(p)
+        order.extend(sorted(
+            (s for s in phases if s.startswith(p + ".")),
+            key=lambda s: -phases[s]["total_s"]))
+    order += [s for s in phases if s not in order]   # orphan sub-phases
     for p in order:
         s = phases[p]
         name = ("  " + p if not _is_top_level(p) else p)

@@ -318,9 +318,23 @@ def main(argv=None) -> int:
                   f"max {sp['max_us'] / 1e3:8.2f}ms")
     if s["profile"]:
         print("\nprofiler phases (ms):")
-        for name, sp in sorted(s["profile"].items(),
-                               key=lambda kv: -kv[1]["total_us"]):
-            print(f"  {name:24s} n={sp['count']:5d} "
+        # Top-level phases by descending total, each followed by its
+        # own nested sub-phases (admit.* under admit, device_sync.*
+        # under device_sync) — indentation reads as containment, and
+        # the dotted rows stay outside the 100 % tiling base.
+        prof = s["profile"]
+        order = []
+        for name in sorted((p for p in prof if "." not in p),
+                           key=lambda p: -prof[p]["total_us"]):
+            order.append(name)
+            order.extend(sorted(
+                (p for p in prof if p.startswith(name + ".")),
+                key=lambda p: -prof[p]["total_us"]))
+        order += [p for p in prof if p not in order]
+        for name in order:
+            sp = prof[name]
+            label = ("  " + name) if "." in name else name
+            print(f"  {label:24s} n={sp['count']:5d} "
                   f"total {sp['total_us'] / 1e3:10.2f} "
                   f"mean {sp['mean_us'] / 1e3:8.3f} "
                   f"max {sp['max_us'] / 1e3:8.3f}  {sp['pct']:5.1f}%")
